@@ -1,0 +1,61 @@
+"""fluxmpi_tpu — TPU-native distributed data-parallel training.
+
+A ground-up TPU rebuild of the capabilities of FluxMPI.jl
+(reference mounted at /root/reference): framework-agnostic, minimally
+intrusive DDP for any optax-compatible training loop, with the MPI+CUDA
+machinery of the reference replaced by XLA collectives compiled over a named
+:class:`jax.sharding.Mesh` — zero MPI/NCCL anywhere.
+
+Public surface (parity with reference exports, src/FluxMPI.jl:88-96):
+
+- runtime: :func:`init`, :func:`is_initialized` (alias ``Initialized``),
+  :func:`local_rank`, :func:`total_workers`, :func:`global_mesh`
+- logging: :func:`fluxmpi_print`, :func:`fluxmpi_println`
+- collectives: :func:`allreduce`, :func:`bcast`, :func:`reduce`,
+  :func:`iallreduce`, :func:`ibcast`, :func:`barrier`
+- sync: :func:`synchronize`
+- gradients: :class:`DistributedOptimizer`, :func:`allreduce_gradients`
+- data: :class:`DistributedDataContainer`
+- config: :mod:`fluxmpi_tpu.config` (preferences)
+"""
+
+from . import config  # noqa: F401
+from .errors import FluxMPINotInitializedError  # noqa: F401
+from .runtime import (  # noqa: F401
+    Initialized,
+    device_count,
+    dp_axis_name,
+    global_mesh,
+    init,
+    is_initialized,
+    local_device_count,
+    local_rank,
+    process_count,
+    process_index,
+    shutdown,
+    total_workers,
+)
+from .logging import fluxmpi_print, fluxmpi_println  # noqa: F401
+from .comm import (  # noqa: F401
+    Request,
+    allreduce,
+    barrier,
+    bcast,
+    cpu,
+    device,
+    host_allreduce,
+    host_bcast,
+    iallreduce,
+    ibcast,
+    reduce,
+    shard_ranks,
+    unshard_ranks,
+)
+
+__version__ = "0.1.0"
+
+# Loaded lazily below to keep `import fluxmpi_tpu` light; these imports are
+# cheap and define the rest of the public API.
+from .sync import synchronize, FluxModelWrapper, FlatParamVector  # noqa: F401,E402
+from .optimizer import DistributedOptimizer, allreduce_gradients  # noqa: F401,E402
+from .data import DistributedDataContainer, DistributedDataLoader  # noqa: F401,E402

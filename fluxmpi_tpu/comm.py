@@ -1,0 +1,385 @@
+"""Eager collective communication over the device mesh.
+
+TPU-native redesign of the reference's "MPIExtensions" layer
+(reference: src/mpi_extensions.jl). The reference exposes eager, host-driven
+collectives — blocking ``allreduce!/bcast!/reduce!`` delegating to libmpi
+(src/mpi_extensions.jl:97-155) and hand-``ccall``ed non-blocking
+``Iallreduce!/Ibcast!`` (src/mpi_extensions.jl:26-88) — with a CPU-staging
+fallback for CUDA-unaware MPI.
+
+Here the transport is XLA collectives over ICI, compiled with ``shard_map``
+over the global mesh. The *semantic model* is preserved exactly: a "per-worker
+value" is a ``jax.Array`` whose leading axis indexes the workers (one slice
+per device, sharded over the data-parallel mesh axis); ``allreduce`` leaves
+every worker holding the reduction, ``bcast`` leaves every worker holding the
+root's slice, ``reduce`` updates only the root's slice. The
+blocking-vs-non-blocking split of the reference collapses into XLA's async
+dispatch: every collective here returns immediately with a future-backed
+array (the analogue of ``Iallreduce!``'s request), and blocking on the result
+is ``.block_until_ready()`` (the analogue of ``MPI.Waitall!``,
+src/optimizer.jl:59). ``iallreduce``/``ibcast`` are provided as explicit
+spellings of that for API parity.
+
+The CUDA-aware/staging dichotomy disappears on ICI; a host-staging debug path
+survives behind ``config.disable_device_collectives()`` (the analogue of the
+reference's CPU-staging fallback, src/mpi_extensions.jl:97-106).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import config
+from .runtime import global_mesh
+
+try:  # jax >= 0.4.35 exposes shard_map at top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+__all__ = [
+    "cpu",
+    "device",
+    "allreduce",
+    "bcast",
+    "reduce",
+    "iallreduce",
+    "ibcast",
+    "barrier",
+    "shard_ranks",
+    "unshard_ranks",
+    "host_allreduce",
+    "host_bcast",
+    "Request",
+]
+
+# ---------------------------------------------------------------------------
+# Device transfer helpers (reference: src/mpi_extensions.jl:5-8 — minimal
+# cpu/gpu adaptors, identity on non-arrays).
+# ---------------------------------------------------------------------------
+
+
+def cpu(x: Any) -> Any:
+    """Move an array to host memory; identity on non-arrays
+    (reference ``cpu``, src/mpi_extensions.jl:7)."""
+    if isinstance(x, (jax.Array, np.ndarray)):
+        return np.asarray(jax.device_get(x))
+    return x
+
+
+def device(x: Any, d: jax.Device | jax.sharding.Sharding | None = None) -> Any:
+    """Move an array to device; identity on non-arrays
+    (reference ``gpu``, src/mpi_extensions.jl:8 — spelled ``device`` here
+    because the target is a TPU chip or a sharding, not a CUDA context)."""
+    if isinstance(x, (jax.Array, np.ndarray)):
+        return jax.device_put(x, d)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# Reduction ops
+# ---------------------------------------------------------------------------
+
+_OP_ALIASES = {
+    "+": "sum",
+    "sum": "sum",
+    "add": "sum",
+    "*": "prod",
+    "prod": "prod",
+    "mul": "prod",
+    "min": "min",
+    "max": "max",
+    "mean": "mean",
+    "avg": "mean",
+}
+
+
+def _canonical_op(op: str) -> str:
+    try:
+        return _OP_ALIASES[op]
+    except (KeyError, TypeError):
+        raise ValueError(
+            f"unsupported reduction op {op!r}; expected one of "
+            f"{sorted(set(_OP_ALIASES))}"
+        ) from None
+
+
+def _tree_reduce_stacked(op: str, stacked: jnp.ndarray, axis: int = 0):
+    if op == "sum":
+        return jnp.sum(stacked, axis=axis)
+    if op == "prod":
+        return jnp.prod(stacked, axis=axis)
+    if op == "min":
+        return jnp.min(stacked, axis=axis)
+    if op == "max":
+        return jnp.max(stacked, axis=axis)
+    if op == "mean":
+        return jnp.mean(stacked, axis=axis)
+    raise AssertionError(op)
+
+
+# ---------------------------------------------------------------------------
+# Per-worker value plumbing
+# ---------------------------------------------------------------------------
+
+
+def _axis_and_size(mesh: Mesh, axis_name: str | None) -> tuple[str, int]:
+    if axis_name is not None:
+        # Explicit names must exist — silently reducing over a different
+        # axis on a typo would produce wrong sums with no error.
+        if axis_name not in mesh.shape:
+            raise ValueError(
+                f"axis {axis_name!r} not in mesh axes {mesh.axis_names}"
+            )
+        return axis_name, mesh.shape[axis_name]
+    name = config.DP_AXIS_NAME if config.DP_AXIS_NAME in mesh.shape else mesh.axis_names[0]
+    return name, mesh.shape[name]
+
+
+def shard_ranks(
+    x: Any, mesh: Mesh | None = None, axis_name: str | None = None
+) -> jax.Array:
+    """Lay a stacked per-worker value ``x`` (leading axis = world size) out
+    across the mesh, one slice per worker."""
+    mesh = mesh or global_mesh()
+    name, size = _axis_and_size(mesh, axis_name)
+    x = jnp.asarray(x)
+    if x.ndim == 0 or x.shape[0] != size:
+        raise ValueError(
+            f"per-worker value must have leading axis == world size {size}, "
+            f"got shape {x.shape}"
+        )
+    spec = P(name, *([None] * (x.ndim - 1)))
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+def unshard_ranks(x: jax.Array) -> np.ndarray:
+    """Gather a per-worker value back to a host numpy array."""
+    return np.asarray(jax.device_get(x))
+
+
+@functools.lru_cache(maxsize=None)
+def _collective_fn(
+    mesh: Mesh, axis: str, kind: str, op: str, root: int
+) -> Callable[[jax.Array], jax.Array]:
+    spec = P(axis)
+
+    def body(x):  # x: [1, ...] — this worker's slice
+        if kind == "allreduce":
+            if op == "sum":
+                return jax.lax.psum(x, axis)
+            if op == "max":
+                return jax.lax.pmax(x, axis)
+            if op == "min":
+                return jax.lax.pmin(x, axis)
+            if op == "mean":
+                return jax.lax.pmean(x, axis)
+            gathered = jax.lax.all_gather(x, axis, axis=0, tiled=True)
+            return _tree_reduce_stacked(op, gathered, axis=0)[None]
+        if kind == "bcast":
+            gathered = jax.lax.all_gather(x, axis, axis=0, tiled=True)
+            return jax.lax.dynamic_slice_in_dim(gathered, root, 1, axis=0)
+        if kind == "reduce":
+            gathered = jax.lax.all_gather(x, axis, axis=0, tiled=True)
+            red = _tree_reduce_stacked(op, gathered, axis=0)[None]
+            idx = jax.lax.axis_index(axis)
+            return jnp.where(idx == root, red, x)
+        raise AssertionError(kind)
+
+    fn = shard_map(body, mesh=mesh, in_specs=(spec,), out_specs=spec)
+    return jax.jit(fn)
+
+
+def _host_collective(
+    x: Any, kind: str, op: str, root: int, mesh: Mesh, axis_name: str
+) -> jax.Array:
+    """Host-staging fallback (debug path; analogue of the reference's
+    CPU-staging for CUDA-unaware MPI, src/mpi_extensions.jl:97-106)."""
+    h = np.asarray(jax.device_get(x))
+    if kind == "allreduce":
+        red = np.asarray(_tree_reduce_stacked(op, jnp.asarray(h), axis=0))
+        out = np.broadcast_to(red[None], h.shape).copy()
+    elif kind == "bcast":
+        out = np.broadcast_to(h[root][None], h.shape).copy()
+    else:  # reduce
+        out = h.copy()
+        out[root] = np.asarray(_tree_reduce_stacked(op, jnp.asarray(h), axis=0))
+    return shard_ranks(out, mesh, axis_name)
+
+
+def _run_collective(
+    x: Any,
+    kind: str,
+    op: str = "sum",
+    root: int = 0,
+    mesh: Mesh | None = None,
+    axis_name: str | None = None,
+) -> jax.Array:
+    mesh = mesh or global_mesh()
+    name, size = _axis_and_size(mesh, axis_name)
+    if not 0 <= root < size:
+        raise ValueError(f"root rank {root} out of range for world size {size}")
+    if config.DEVICE_COLLECTIVES_DISABLED:
+        xs = jnp.asarray(x)
+        if xs.ndim == 0 or xs.shape[0] != size:
+            raise ValueError(
+                f"per-worker value must have leading axis == world size "
+                f"{size}, got shape {xs.shape}"
+            )
+        return _host_collective(xs, kind, op, root, mesh, name)
+    xs = shard_ranks(x, mesh, name)
+    fn = _collective_fn(mesh, name, kind, op, root)
+    return fn(xs)
+
+
+# ---------------------------------------------------------------------------
+# Public eager collectives (reference: src/mpi_extensions.jl:26-155)
+# ---------------------------------------------------------------------------
+
+
+def allreduce(
+    x: Any,
+    op: str = "sum",
+    *,
+    mesh: Mesh | None = None,
+    axis_name: str | None = None,
+) -> jax.Array:
+    """All-reduce a per-worker value: every worker's slice becomes the
+    reduction of all workers' slices.
+
+    Analogue of ``allreduce!`` (reference: src/mpi_extensions.jl:97-111),
+    lowered to an XLA AllReduce over ICI instead of ``MPI.Allreduce!``.
+    ``x`` has leading axis == world size (one slice per worker).
+    """
+    return _run_collective(x, "allreduce", _canonical_op(op), 0, mesh, axis_name)
+
+
+def bcast(
+    x: Any,
+    root: int = 0,
+    *,
+    mesh: Mesh | None = None,
+    axis_name: str | None = None,
+) -> jax.Array:
+    """Broadcast the root worker's slice to all workers.
+
+    Analogue of ``bcast!`` (reference: src/mpi_extensions.jl:119-133), lowered
+    to XLA all-gather + slice (collective-broadcast) instead of ``MPI.Bcast!``.
+    """
+    return _run_collective(x, "bcast", "sum", root, mesh, axis_name)
+
+
+def reduce(
+    x: Any,
+    op: str = "sum",
+    root: int = 0,
+    *,
+    mesh: Mesh | None = None,
+    axis_name: str | None = None,
+) -> jax.Array:
+    """Reduce to the root worker: root's slice becomes the reduction, other
+    workers keep their input slice.
+
+    Analogue of ``reduce!`` (reference: src/mpi_extensions.jl:141-155). On ICI
+    an all-reduce is as cheap as a rooted reduce, so this lowers to
+    all-gather + local reduce masked to the root.
+    """
+    return _run_collective(x, "reduce", _canonical_op(op), root, mesh, axis_name)
+
+
+class Request:
+    """Completion handle for the non-blocking spellings.
+
+    The analogue of ``MPI.Request`` returned by the reference's hand-bound
+    ``Iallreduce!``/``Ibcast!`` (src/mpi_extensions.jl:26-88). On TPU every
+    collective is async-dispatched by the XLA runtime; ``wait()`` is the
+    analogue of ``MPI.Wait!``/``Waitall!`` (src/optimizer.jl:59).
+    """
+
+    def __init__(self, value: jax.Array) -> None:
+        self._value = value
+
+    def wait(self) -> jax.Array:
+        self._value.block_until_ready()
+        return self._value
+
+    @staticmethod
+    def wait_all(requests: "list[Request]") -> list[jax.Array]:
+        return [r.wait() for r in requests]
+
+
+def iallreduce(
+    x: Any,
+    op: str = "sum",
+    *,
+    mesh: Mesh | None = None,
+    axis_name: str | None = None,
+) -> tuple[jax.Array, Request]:
+    """Non-blocking all-reduce: returns ``(value, request)`` immediately;
+    the value materializes asynchronously (reference ``Iallreduce!``,
+    src/mpi_extensions.jl:26-60)."""
+    out = allreduce(x, op, mesh=mesh, axis_name=axis_name)
+    return out, Request(out)
+
+
+def ibcast(
+    x: Any,
+    root: int = 0,
+    *,
+    mesh: Mesh | None = None,
+    axis_name: str | None = None,
+) -> tuple[jax.Array, Request]:
+    """Non-blocking broadcast (reference ``Ibcast!``,
+    src/mpi_extensions.jl:70-88)."""
+    out = bcast(x, root, mesh=mesh, axis_name=axis_name)
+    return out, Request(out)
+
+
+def barrier(tag: str = "fluxmpi_barrier") -> None:
+    """Block until all processes reach this point.
+
+    Analogue of ``MPI.Barrier`` (reference: src/common.jl:91). Multi-host:
+    a global device sync; single-process: drain local async dispatch.
+    """
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+    else:
+        jax.effects_barrier()
+
+
+# ---------------------------------------------------------------------------
+# Host-level cross-process collectives (multi-host SPMD): operate on values
+# that live per controller process, the closest analogue of the reference's
+# per-rank host arrays when each process drives several chips.
+# ---------------------------------------------------------------------------
+
+
+def host_allreduce(x: Any, op: str = "sum") -> np.ndarray:
+    """Reduce a per-process host value across all controller processes."""
+    op = _canonical_op(op)
+    h = np.asarray(x)
+    if jax.process_count() == 1:
+        return h
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(h, tiled=False)
+    return np.asarray(_tree_reduce_stacked(op, jnp.asarray(gathered), axis=0))
+
+
+def host_bcast(x: Any, root: int = 0) -> np.ndarray:
+    """Broadcast a per-process host value from the root process to all."""
+    h = np.asarray(x)
+    if jax.process_count() == 1:
+        return h
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.broadcast_one_to_all(h, is_source=jax.process_index() == root))

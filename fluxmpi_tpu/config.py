@@ -1,0 +1,158 @@
+"""Persistent configuration / preferences.
+
+TPU-native analogue of the reference's Preferences.jl-backed config surface
+(reference: src/FluxMPI.jl:16-31, 51-56). The reference persists a single flag
+(``FluxMPIDisableCUDAMPISupport``) to LocalPreferences.toml, reads it once at
+module ``__init__``, and warns on a deprecated env var. On TPU the
+CUDA-aware-vs-CPU-staging dichotomy disappears (device buffers are always
+collective-capable over ICI), so the analogous knobs here govern the things
+that actually matter on TPU: whether eager host-level collectives stage
+through the host instead of running on the device mesh, buffer donation in
+compiled train steps, and the default mesh axis name.
+
+Preferences are stored in a JSON file next to the consuming project
+(``./LocalPreferences.json``, the direct analogue of LocalPreferences.toml),
+overridable via ``FLUXMPI_TPU_PREFS`` and per-key env vars
+``FLUXMPI_TPU_<KEY>``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import warnings
+from typing import Any
+
+_PREFS_ENV = "FLUXMPI_TPU_PREFS"
+_PREFS_BASENAME = "LocalPreferences.json"
+_PREFS_NAMESPACE = "fluxmpi_tpu"
+
+# Reference parity: warn on the removed env var (src/FluxMPI.jl:17-19 warns on
+# FLUXMPI_DISABLE_CUDAMPI_SUPPORT). That knob has no TPU meaning; we point
+# users at the TPU-relevant replacement.
+_DEPRECATED_ENV = "FLUXMPI_DISABLE_CUDAMPI_SUPPORT"
+
+_DEFAULTS: dict[str, Any] = {
+    # Force eager collectives to stage via host numpy instead of the device
+    # mesh (debugging aid; the analogue of the reference's CPU-staging path,
+    # src/mpi_extensions.jl:97-106).
+    "disable_device_collectives": False,
+    # Donate parameter/optimizer buffers in compiled train steps.
+    "donate_buffers": True,
+    # Default name of the data-parallel mesh axis.
+    "dp_axis_name": "dp",
+    # Default name of the sequence-parallel mesh axis (ring attention).
+    "sp_axis_name": "sp",
+}
+
+
+def _prefs_path() -> str:
+    return os.environ.get(_PREFS_ENV, os.path.join(os.getcwd(), _PREFS_BASENAME))
+
+
+def _read_file() -> dict[str, Any]:
+    path = _prefs_path()
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return {}
+    ns = data.get(_PREFS_NAMESPACE, {})
+    return ns if isinstance(ns, dict) else {}
+
+
+def _coerce(value: str, like: Any) -> Any:
+    if isinstance(like, bool):
+        return value.strip().lower() in ("1", "true", "yes", "on")
+    if isinstance(like, int):
+        return int(value)
+    if isinstance(like, float):
+        return float(value)
+    return value
+
+
+def load_preference(key: str, default: Any = None) -> Any:
+    """Read preference ``key``: env var > preferences file > default.
+
+    Analogue of ``@load_preference`` (reference: src/FluxMPI.jl:21).
+    """
+    fallback = _DEFAULTS.get(key, default)
+    env_key = f"FLUXMPI_TPU_{key.upper()}"
+    if env_key in os.environ:
+        return _coerce(os.environ[env_key], fallback)
+    file_prefs = _read_file()
+    if key in file_prefs:
+        return file_prefs[key]
+    return fallback
+
+
+def set_preference(key: str, value: Any) -> None:
+    """Persist preference ``key`` to the preferences file.
+
+    Analogue of ``@set_preferences!`` (reference: src/FluxMPI.jl:53). Takes
+    effect for values read after the call; module-level cached flags (see
+    :func:`disable_device_collectives`) need a fresh session, matching the
+    reference's restart requirement (src/FluxMPI.jl:55).
+    """
+    path = _prefs_path()
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        if not isinstance(data, dict):
+            data = {}
+    except (FileNotFoundError, json.JSONDecodeError):
+        data = {}
+    data.setdefault(_PREFS_NAMESPACE, {})[key] = value
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def delete_preference(key: str) -> None:
+    """Remove a persisted preference (no-op if absent)."""
+    path = _prefs_path()
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return
+    if isinstance(data, dict) and key in data.get(_PREFS_NAMESPACE, {}):
+        del data[_PREFS_NAMESPACE][key]
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=2, sort_keys=True)
+            f.write("\n")
+
+
+def disable_device_collectives() -> None:
+    """Persistently force eager collectives onto the host-staging path.
+
+    The TPU analogue of ``FluxMPI.disable_cudampi_support()``
+    (reference: src/FluxMPI.jl:51-56): a persisted opt-out of the fast
+    transport, requiring a session restart to take effect, kept as a
+    debugging escape hatch.
+    """
+    set_preference("disable_device_collectives", True)
+    warnings.warn(
+        "Device-mesh collectives disabled for future sessions; restart the "
+        "session for this to take effect.",
+        stacklevel=2,
+    )
+
+
+def _warn_deprecated_env() -> None:
+    if _DEPRECATED_ENV in os.environ:
+        warnings.warn(
+            f"`{_DEPRECATED_ENV}` is ignored: there is no CUDA-aware-MPI "
+            "dichotomy on TPU. Use "
+            "`fluxmpi_tpu.config.disable_device_collectives()` if you need "
+            "the host-staging debug path.",
+            stacklevel=2,
+        )
+
+
+# Cached at import, mirroring the reference's read-once-at-__init__ semantics
+# (src/FluxMPI.jl:21-31).
+_warn_deprecated_env()
+DEVICE_COLLECTIVES_DISABLED: bool = bool(load_preference("disable_device_collectives"))
+DP_AXIS_NAME: str = str(load_preference("dp_axis_name"))
+SP_AXIS_NAME: str = str(load_preference("sp_axis_name"))

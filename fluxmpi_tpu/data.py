@@ -1,0 +1,217 @@
+"""Dataset sharding and the device-feeding data loader.
+
+TPU-native redesign of the reference's data layer (reference: src/data.jl).
+The reference's ``DistributedDataContainer`` wraps any MLUtils-style
+container, computes ``size_per_process = ceil(total / nworkers)``, takes the
+contiguous partition of indices belonging to ``local_rank()``, and remaps
+``getindex`` — the last rank holds the (smaller) remainder
+(src/data.jl:13-26; asserted by test/test_data.jl:15-20). No communication at
+iteration time.
+
+Parity here is exact (same ceil-partition math, same remainder-on-last-rank),
+with the world defaulting to the controller-process world: each process loads
+only its shard, and :class:`DistributedDataLoader` assembles per-process
+batches into **global** jax Arrays laid out over the data-parallel mesh axis
+(``jax.make_array_from_process_local_data``) — the step from "each rank sees
+its data" to "the compiled step sees one sharded global batch" that has no
+analogue in MPI-land.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Iterator, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import config
+from .runtime import global_mesh
+
+__all__ = ["DistributedDataContainer", "DistributedDataLoader"]
+
+
+def _shard_bounds(total_size: int, rank: int, world: int) -> range:
+    """Contiguous ceil-partition (reference: src/data.jl:14-19)."""
+    size_per_process = math.ceil(total_size / world)
+    n_partitions = math.ceil(total_size / size_per_process) if size_per_process else 0
+    if rank >= n_partitions:
+        # The reference errors here too (BoundsError on the partition list).
+        raise IndexError(
+            f"rank {rank} has no data shard: {total_size} samples across "
+            f"{world} workers yields only {n_partitions} non-empty shards"
+        )
+    start = rank * size_per_process
+    stop = min(start + size_per_process, total_size)
+    return range(start, stop)
+
+
+class DistributedDataContainer:
+    """Shard any indexable dataset contiguously by worker rank.
+
+    Reference: ``DistributedDataContainer`` (src/data.jl:8-26). ``data`` must
+    support ``len`` and ``__getitem__``. Rank/world default to the
+    controller-process world (each process loads its own shard; per-device
+    slicing happens downstream in the loader via the mesh). Pass explicit
+    ``rank``/``world`` to shard at any other granularity (e.g. per device).
+    """
+
+    def __init__(self, data: Any, *, rank: int | None = None, world: int | None = None):
+        self.data = data
+        if (rank is None) != (world is None):
+            raise ValueError("pass rank and world together, or neither")
+        if world is not None and (
+            jax.process_count() > 1
+            and world == jax.device_count()
+            and rank == jax.process_index()
+            and jax.local_device_count() > 1
+        ):
+            import warnings
+
+            warnings.warn(
+                "rank looks like a process index but world equals the global "
+                "device count; with multiple chips per process these "
+                "granularities differ — the default (no rank/world) shards "
+                "per process, which is what the data loader expects.",
+                stacklevel=2,
+            )
+        world = world if world is not None else jax.process_count()
+        rank = rank if rank is not None else jax.process_index()
+        self.rank = rank
+        self.world = world
+        self.total_size = len(data)
+        self.idxs = _shard_bounds(self.total_size, rank, world)
+
+    def min_shard_size(self) -> int:
+        """Size of the smallest shard in this container's world (the last
+        rank's remainder shard) — every process can serve at least this many
+        samples, which keeps multi-process iteration in lockstep."""
+        spp = math.ceil(self.total_size / self.world)
+        return self.total_size - (self.world - 1) * spp
+
+    def __len__(self) -> int:
+        return len(self.idxs)  # reference: src/data.jl:24
+
+    def __getitem__(self, i: int) -> Any:
+        return self.data[self.idxs[i]]  # index remap, reference: src/data.jl:26
+
+    def __iter__(self) -> Iterator[Any]:
+        for i in range(len(self)):
+            yield self[i]
+
+
+def _stack_samples(samples: Sequence[Any]) -> Any:
+    """Collate a list of samples (pytrees of arrays/scalars) into batched
+    numpy arrays."""
+    return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *samples)
+
+
+class DistributedDataLoader:
+    """Iterate global, mesh-sharded batches from per-process data.
+
+    The TPU-side counterpart of putting a ``DistributedDataContainer`` inside
+    ``MLUtils.DataLoader`` (reference README.md:47-49): each process draws
+    samples from its shard, collates a per-process batch of
+    ``global_batch_size / process_count``, and assembles a global
+    ``jax.Array`` sharded over the data-parallel mesh axis so a jitted train
+    step consumes it directly.
+
+    Args:
+      data: an indexable dataset (a :class:`DistributedDataContainer` for the
+        usual per-process sharding, or any ``len``/``getitem`` container).
+      global_batch_size: total batch across all workers; must divide by
+        ``process_count`` (and the per-process batch by the local device
+        count for even device layout).
+      mesh: defaults to the runtime's global mesh.
+      axis_name: mesh axis to shard the batch dimension over.
+      shuffle/seed: reshuffle shard indices each epoch with a per-epoch key.
+      drop_last: drop the trailing incomplete batch (default True — a ragged
+        final batch would retrigger XLA compilation).
+    """
+
+    def __init__(
+        self,
+        data: Any,
+        global_batch_size: int,
+        *,
+        mesh: Mesh | None = None,
+        axis_name: str | None = None,
+        shuffle: bool = False,
+        seed: int = 0,
+        drop_last: bool = True,
+    ):
+        self.data = data
+        self.mesh = mesh
+        self.axis_name = axis_name or config.DP_AXIS_NAME
+        if global_batch_size % jax.process_count() != 0:
+            raise ValueError(
+                f"global_batch_size {global_batch_size} must divide evenly "
+                f"across {jax.process_count()} processes"
+            )
+        self.global_batch_size = global_batch_size
+        self.local_batch_size = global_batch_size // jax.process_count()
+        mesh_for_check = mesh
+        if mesh_for_check is None:
+            try:
+                mesh_for_check = global_mesh()
+            except Exception:
+                mesh_for_check = None
+        if mesh_for_check is not None:
+            axis = self.axis_name
+            axis_size = mesh_for_check.shape.get(axis, 1)
+            if global_batch_size % axis_size != 0:
+                raise ValueError(
+                    f"global_batch_size {global_batch_size} must be divisible "
+                    f"by the '{axis}' mesh axis size {axis_size} so every "
+                    f"device gets an equal slice"
+                )
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self._epoch = 0
+        # Per-process shard sizes can differ (ceil partition, remainder on
+        # the last rank). jax.make_array_from_process_local_data is a
+        # cross-process collective, so every process MUST yield the same
+        # number of batches or iteration deadlocks mid-epoch. Compute the
+        # common (minimum) serveable length once.
+        if isinstance(data, DistributedDataContainer):
+            self._common_len = data.min_shard_size()
+        elif jax.process_count() > 1:  # pragma: no cover - multihost only
+            from .comm import host_allreduce
+
+            self._common_len = int(
+                host_allreduce(np.asarray(len(data)), op="min")
+            )
+        else:
+            self._common_len = len(data)
+
+    def __len__(self) -> int:
+        if self.drop_last:
+            return self._common_len // self.local_batch_size
+        return math.ceil(self._common_len / self.local_batch_size)
+
+    def _sharding(self) -> NamedSharding:
+        mesh = self.mesh or global_mesh()
+        return NamedSharding(mesh, P(self.axis_name))
+
+    def __iter__(self) -> Iterator[Any]:
+        n = len(self.data)
+        order = np.arange(n)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self._epoch)
+            rng.shuffle(order)
+        self._epoch += 1
+        sharding = self._sharding()
+
+        nbatches = len(self)
+        for b in range(nbatches):
+            idxs = order[b * self.local_batch_size : (b + 1) * self.local_batch_size]
+            batch = _stack_samples([self.data[int(i)] for i in idxs])
+            yield jax.tree_util.tree_map(
+                lambda x: jax.make_array_from_process_local_data(
+                    sharding, np.asarray(x)
+                ),
+                batch,
+            )

@@ -1,0 +1,28 @@
+"""Framework error types.
+
+TPU-native analogue of the reference's ``FluxMPINotInitializedError``
+(reference: src/FluxMPI.jl:59-63).
+"""
+
+from __future__ import annotations
+
+
+class FluxMPINotInitializedError(RuntimeError):
+    """Raised when a rank/world query is made before :func:`fluxmpi_tpu.init`.
+
+    Mirrors the reference error struct and message intent
+    (reference: src/FluxMPI.jl:59-63): the runtime must be brought up with
+    ``init()`` before ``local_rank()`` / ``total_workers()`` are meaningful.
+    """
+
+    def __init__(self, message: str | None = None) -> None:
+        super().__init__(
+            message
+            or "fluxmpi_tpu has not been initialized. Call `fluxmpi_tpu.init()` "
+            "before querying `local_rank()` / `total_workers()` or using "
+            "collectives."
+        )
+
+
+class CollectiveError(RuntimeError):
+    """Raised when an eager collective cannot be lowered or executed."""

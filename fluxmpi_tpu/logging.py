@@ -1,0 +1,72 @@
+"""Rank-aware, timestamped printing.
+
+TPU-native analogue of the reference's metaprogrammed ``fluxmpi_print`` /
+``fluxmpi_println`` pair (reference: src/common.jl:72-112):
+
+- pre-init: timestamp-only prefix (src/common.jl:76-79);
+- single worker: plain print (src/common.jl:82-85);
+- multi-process world: timestamp + ``[rank / size]`` prefix, output
+  serialized across processes by looping ranks with a barrier between each
+  (src/common.jl:86-92). On TPU the barrier is a host-level global sync
+  (``multihost_utils.sync_global_devices``) rather than ``MPI.Barrier``;
+  within one controller process there is nothing to serialize.
+
+These functions do host-side IO only and are never traced — the analogue of
+the reference's ``@non_differentiable`` marks (src/common.jl:96).
+"""
+
+from __future__ import annotations
+
+import datetime
+import itertools
+import sys
+from typing import Any
+
+import jax
+
+from .runtime import is_initialized
+
+__all__ = ["fluxmpi_print", "fluxmpi_println"]
+
+_print_counter = itertools.count()
+
+
+def _now() -> str:
+    return datetime.datetime.now().isoformat(sep=" ", timespec="milliseconds")
+
+
+def _barrier(tag: str) -> None:
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(tag)
+
+
+def _rank_print(*args: Any, end: str, **kwargs: Any) -> None:
+    if not is_initialized():
+        print(f"{_now()} ", *args, end=end, **kwargs)
+        return
+    rank = jax.process_index()
+    size = jax.process_count()
+    if size == 1:
+        print(*args, end=end, **kwargs)
+        return
+    # Serialize output across processes: each rank prints in turn with a
+    # global barrier between turns (reference: src/common.jl:86-92).
+    tag = f"fluxmpi_print_{next(_print_counter)}"
+    for r in range(size):
+        if r == rank:
+            print(f"{_now()} [{rank} / {size}] ", *args, end=end, **kwargs)
+            sys.stdout.flush()
+        _barrier(f"{tag}_{r}")
+
+
+def fluxmpi_print(*args: Any, **kwargs: Any) -> None:
+    """Print with timestamp + ``[rank / size]`` prefix, serialized across
+    processes (reference: src/common.jl:72-112)."""
+    _rank_print(*args, end=kwargs.pop("end", ""), **kwargs)
+
+
+def fluxmpi_println(*args: Any, **kwargs: Any) -> None:
+    """:func:`fluxmpi_print` with a trailing newline
+    (reference ``fluxmpi_println``, src/common.jl:72-112)."""
+    _rank_print(*args, end=kwargs.pop("end", "\n"), **kwargs)

@@ -1,0 +1,257 @@
+"""Runtime bring-up, world identity, and the global device mesh.
+
+TPU-native redesign of the reference's L3 runtime (reference: src/common.jl).
+The reference world is MPI: ``mpiexecjl`` spawns one OS process per rank, each
+rank binds one GPU round-robin (src/common.jl:16-45), and every collective
+runs over ``MPI.COMM_WORLD``. The TPU world is SPMD over a named device mesh:
+``init()`` optionally joins a multi-host pod slice
+(``jax.distributed.initialize``), then builds a :class:`jax.sharding.Mesh`
+over all global devices. XLA owns device binding — there is no analogue of
+``CUDA.device!`` because every collective is compiled against the mesh.
+
+Identity mapping (the reference collapses process == rank == GPU; a TPU
+controller process drives several chips, so the two notions split):
+
+- :func:`total_workers` — the number of data-parallel workers, i.e. global
+  device count (reference: ``MPI.Comm_size``, src/common.jl:64-69; one worker
+  held one GPU there, one worker is one TPU chip here).
+- :func:`local_rank` — the rank of this controller process
+  (reference: ``MPI.Comm_rank``, src/common.jl:52-57). Use
+  :func:`process_count` / :func:`local_device_count` for the full picture.
+
+Both queries raise ``FluxMPINotInitializedError`` before ``init()``
+(reference: src/common.jl:53,65) and are safe inside differentiated code: they
+return Python ints, invisible to tracing — the analogue of the reference's
+``@non_differentiable`` marks (src/common.jl:57,69).
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from . import config
+from .errors import FluxMPINotInitializedError
+
+__all__ = [
+    "init",
+    "is_initialized",
+    "Initialized",
+    "shutdown",
+    "local_rank",
+    "total_workers",
+    "process_index",
+    "process_count",
+    "device_count",
+    "local_device_count",
+    "global_mesh",
+    "dp_axis_name",
+]
+
+
+class _RuntimeState:
+    initialized: bool = False
+    mesh: Mesh | None = None
+    distributed: bool = False
+
+
+_state = _RuntimeState()
+
+
+def _should_init_distributed() -> bool:
+    """Heuristic for joining a multi-host world at ``init()``.
+
+    The reference always calls ``MPI.Init()`` because ``mpiexecjl`` created
+    the world (src/common.jl:22). On TPU the world exists iff we run on a pod
+    slice or the coordinator is configured explicitly.
+    """
+    if os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
+        "COORDINATOR_ADDRESS"
+    ):
+        return True
+    # Cloud TPU pod slice: multiple workers announced by the TPU VM runtime.
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    return len([h for h in hostnames.split(",") if h]) > 1
+
+
+def init(
+    *,
+    devices: Sequence[jax.Device] | None = None,
+    mesh_shape: dict[str, int] | None = None,
+    distributed: bool | None = None,
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    verbose: bool = False,
+) -> Mesh:
+    """Bring up the fluxmpi_tpu runtime. Idempotent.
+
+    TPU-native analogue of ``FluxMPI.Init`` (reference: src/common.jl:16-45):
+
+    - joins the multi-host world when on a pod slice (analogue of
+      ``MPI.Init()`` joining the mpiexec world, src/common.jl:22);
+    - builds the global device mesh (analogue of rank→GPU round-robin
+      binding, src/common.jl:31-42 — on TPU the mesh *is* the binding);
+    - warns when running with a single worker (parity with
+      src/common.jl:25-27).
+
+    Args:
+      devices: devices to build the mesh over; defaults to all global devices.
+      mesh_shape: ordered ``{axis_name: size}``; one size may be ``-1``
+        (inferred). Defaults to a 1-D data-parallel mesh
+        ``{config.DP_AXIS_NAME: ndevices}``.
+      distributed: force (or forbid) ``jax.distributed.initialize``; default
+        auto-detects a pod slice / explicit coordinator.
+      coordinator_address, num_processes, process_id: forwarded to
+        ``jax.distributed.initialize`` when joining explicitly.
+      verbose: print world info from every rank (reference ``verbose`` kwarg,
+        src/common.jl:16).
+
+    Returns:
+      The global :class:`jax.sharding.Mesh`.
+    """
+    from .logging import fluxmpi_println  # local import: avoid cycle
+
+    if _state.initialized:
+        if verbose:
+            fluxmpi_println("fluxmpi_tpu already initialized; skipping...")
+        assert _state.mesh is not None
+        return _state.mesh
+
+    if distributed is None:
+        distributed = coordinator_address is not None or _should_init_distributed()
+    if distributed and not _state.distributed:
+        # Must run before ANY backend use (jax.devices/process_count/...)
+        # or the coordinator handshake cannot happen. A failure here must be
+        # loud: silently degrading a pod slice to independent single-process
+        # worlds would train without gradient sync and produce wrong results.
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+            _state.distributed = True
+        except RuntimeError as e:  # pragma: no cover - deployment-specific
+            if "already" in str(e).lower():
+                _state.distributed = True
+            else:
+                raise
+
+    devs = list(devices) if devices is not None else jax.devices()
+    if mesh_shape is None:
+        mesh_shape = {config.DP_AXIS_NAME: len(devs)}
+    axis_names = tuple(mesh_shape.keys())
+    sizes = list(mesh_shape.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one mesh axis may have inferred size -1")
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        if len(devs) % known != 0:
+            raise ValueError(
+                f"cannot infer mesh axis: {len(devs)} devices not divisible "
+                f"by {known}"
+            )
+        sizes[sizes.index(-1)] = len(devs) // known
+    if int(np.prod(sizes)) != len(devs):
+        raise ValueError(
+            f"mesh_shape {dict(zip(axis_names, sizes))} does not cover "
+            f"{len(devs)} devices"
+        )
+
+    mesh = Mesh(np.asarray(devs).reshape(sizes), axis_names)
+    _state.mesh = mesh
+    _state.initialized = True
+
+    if verbose:
+        if total_workers() == 1:
+            warnings.warn(
+                "Using fluxmpi_tpu with only 1 worker. It might be faster to "
+                "run the code without the distributed wrappers.",
+                stacklevel=2,
+            )
+        fluxmpi_println(
+            f"Initialized: {jax.process_count()} process(es), "
+            f"{len(devs)} device(s), mesh axes {dict(zip(axis_names, sizes))}, "
+            f"platform {devs[0].platform}"
+        )
+    return mesh
+
+
+def is_initialized() -> bool:
+    """Has the runtime been initialized? (reference: src/common.jl:6)."""
+    return _state.initialized
+
+
+# Reference-spelling alias (``FluxMPI.Initialized``).
+Initialized = is_initialized
+
+
+def shutdown() -> None:
+    """Reset runtime state (test helper; analogue of ``MPI.Finalize`` in the
+    reference test files, e.g. test/test_common.jl:15)."""
+    _state.initialized = False
+    _state.mesh = None
+
+
+def _require_init() -> None:
+    if not _state.initialized:
+        raise FluxMPINotInitializedError()
+
+
+def local_rank() -> int:
+    """Rank of this controller process (reference: src/common.jl:52-57)."""
+    _require_init()
+    return jax.process_index()
+
+
+def total_workers() -> int:
+    """Total number of data-parallel workers — global device count
+    (reference: src/common.jl:64-69; there 1 worker == 1 GPU == 1 process,
+    here 1 worker == 1 TPU chip)."""
+    _require_init()
+    return int(np.prod(list(_state.mesh.shape.values())))  # type: ignore[union-attr]
+
+
+def process_index() -> int:
+    """Index of this controller process in the multi-host world."""
+    _require_init()
+    return jax.process_index()
+
+
+def process_count() -> int:
+    """Number of controller processes in the multi-host world."""
+    _require_init()
+    return jax.process_count()
+
+
+def device_count() -> int:
+    """Global device count."""
+    _require_init()
+    return jax.device_count()
+
+
+def local_device_count() -> int:
+    """Devices addressable by this process."""
+    _require_init()
+    return jax.local_device_count()
+
+
+def global_mesh() -> Mesh:
+    """The mesh built by :func:`init` — the analogue of ``MPI.COMM_WORLD``
+    (reference passes the world comm to every collective,
+    e.g. src/optimizer.jl:21, src/synchronize.jl:16)."""
+    _require_init()
+    assert _state.mesh is not None
+    return _state.mesh
+
+
+def dp_axis_name() -> str:
+    """Name of the data-parallel mesh axis."""
+    return config.DP_AXIS_NAME

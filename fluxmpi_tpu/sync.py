@@ -1,0 +1,207 @@
+"""Pytree synchronization — broadcast params/state from a root rank.
+
+TPU-native redesign of the reference's recursive ``synchronize!``
+(reference: src/synchronize.jl). The reference walks an arbitrary state tree
+with Functors and issues one blocking ``MPI.Bcast!`` per numeric leaf
+(src/synchronize.jl:15-17), with special dispatches for optimizer leaves,
+scalars, array-of-arrays, and a catch-all no-op (src/synchronize.jl:35).
+
+Here state trees are JAX pytrees, and the divergence that synchronization
+must erase lives at the *controller process* level (per-process RNG or
+host-side init divergence — the analogue of per-MPI-rank divergence; within
+one process, device replicas cannot diverge because jit keeps them
+consistent). ``synchronize`` therefore broadcasts from the root *process*
+over the multi-host transport, and is the identity in a single-process world
+(world size 1) — exactly the reference's behavior at ``size == 1``.
+
+The leaf-dispatch semantics are preserved exactly:
+
+- pytree containers (dict/NamedTuple/tuple/list, optax states, flax
+  FrozenDict) → recurse (reference: src/synchronize.jl:10-13, 24-27; optax
+  optimizer states are plain pytrees, so the reference's ``Optimisers.Leaf``
+  special case falls out for free);
+- numeric arrays → broadcast from root (src/synchronize.jl:15-17);
+- object arrays of arrays → recurse elementwise (src/synchronize.jl:20-22);
+- Python/numpy scalars → broadcast as 1-element array, return scalar
+  (src/synchronize.jl:29-31);
+- anything else (str/None/callables/Sentinels) → identity no-op
+  (src/synchronize.jl:33-35).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .comm import host_bcast
+
+__all__ = ["synchronize", "FluxModelWrapper", "FlatParamVector"]
+
+
+def _sync_array(x: Any, root_rank: int) -> Any:
+    """Broadcast one numeric array leaf from the root process.
+
+    For device arrays the result is laid out **replicated over the global
+    mesh** — the TPU meaning of "every worker now holds the root's value"
+    (the reference's ``bcast!`` leaves every rank's buffer equal,
+    src/synchronize.jl:15-17; here the workers are mesh devices, so the
+    synced tree is immediately consumable by a mesh-sharded train step).
+    """
+    if isinstance(x, jax.Array):
+        synced = host_bcast(np.asarray(jax.device_get(x)), root=root_rank)
+        out = jnp.asarray(synced, dtype=x.dtype)
+        from .runtime import is_initialized, global_mesh
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        if is_initialized():
+            return jax.device_put(
+                out, NamedSharding(global_mesh(), PartitionSpec())
+            )
+        return jax.device_put(out, x.sharding)
+    return host_bcast(np.asarray(x), root=root_rank)
+
+
+def _sync_leaf(x: Any, root_rank: int) -> Any:
+    if isinstance(x, (jax.Array,)) or (
+        isinstance(x, np.ndarray) and x.dtype != object
+    ):
+        if np.issubdtype(np.asarray(jax.device_get(x)).dtype, np.number) or np.issubdtype(
+            np.asarray(jax.device_get(x)).dtype, np.bool_
+        ):
+            return _sync_array(x, root_rank)
+        return x
+    if isinstance(x, np.ndarray) and x.dtype == object:
+        # Array-of-arrays: recurse elementwise (reference:
+        # src/synchronize.jl:20-22).
+        out = np.empty_like(x)
+        for idx in np.ndindex(x.shape):
+            out[idx] = synchronize(x[idx], root_rank=root_rank)
+        return out
+    if isinstance(x, (bool, np.bool_)):
+        return bool(host_bcast(np.asarray([x]), root=root_rank)[0])
+    if isinstance(x, (int, float, complex, np.number)):
+        synced = host_bcast(np.asarray([x]), root=root_rank)[0]
+        return type(x)(synced) if not isinstance(x, np.number) else synced
+    # Unknown leaf kinds are left alone (reference: src/synchronize.jl:35).
+    return x
+
+
+def synchronize(tree: Any, *, root_rank: int = 0) -> Any:
+    """Synchronize ``tree`` across all controller processes.
+
+    Every process returns the root process's values. Call this after model /
+    optimizer init (which may diverge per process) — the three setup calls of
+    the reference quick-start (params, model state, optimizer state;
+    reference README.md:43-44,54). Pure (returns a new tree); the reference's
+    in-place mutation has no JAX analogue.
+    """
+    if isinstance(tree, FluxModelWrapper):
+        return _sync_wrapped_model(tree, root_rank)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not leaves:
+        return tree  # empty fast-path (reference: src/synchronize.jl:11)
+    return jax.tree_util.tree_unflatten(
+        treedef, [_sync_leaf(leaf, root_rank) for leaf in leaves]
+    )
+
+
+# ---------------------------------------------------------------------------
+# Wrapped-model adapter (reference: ext/FluxMPIFluxExt.jl + marker struct
+# src/FluxMPI.jl:81-86). Flux models are arbitrary mutable structs the
+# reference cannot dispatch on, hence the marker wrapper. The JAX analogue:
+# most state is already a pytree, but user classes holding arrays in
+# attributes are not — the wrapper walks their attributes.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class FluxModelWrapper:
+    """Marker wrapper for a non-pytree model object whose attributes hold
+    state to synchronize (reference ``FluxMPIFluxModel``,
+    src/FluxMPI.jl:84-86)."""
+
+    model: Any
+
+
+def _sync_object(obj: Any, root_rank: int, _depth: int = 0) -> Any:
+    if _depth > 32:
+        return obj
+    treedef = jax.tree_util.tree_structure(obj)
+    if not jax.tree_util.treedef_is_leaf(treedef) or not hasattr(obj, "__dict__"):
+        # A registered pytree (or something without attributes): sync directly.
+        return synchronize(obj, root_rank=root_rank)
+    for name, value in vars(obj).items():
+        if name.startswith("_"):
+            continue
+        vdef = jax.tree_util.tree_structure(value)
+        if jax.tree_util.treedef_is_leaf(vdef) and hasattr(value, "__dict__"):
+            setattr(obj, name, _sync_object(value, root_rank, _depth + 1))
+        else:
+            setattr(obj, name, synchronize(value, root_rank=root_rank))
+    return obj
+
+
+def _sync_wrapped_model(wrapped: FluxModelWrapper, root_rank: int) -> FluxModelWrapper:
+    return FluxModelWrapper(_sync_object(wrapped.model, root_rank))
+
+
+# ---------------------------------------------------------------------------
+# Flat-parameter-vector adapter (reference: ext/FluxMPIComponentArraysExt.jl
+# — sync a whole parameter tree with ONE collective on the flat underlying
+# vector, rewrapping with the original axes).
+# ---------------------------------------------------------------------------
+
+
+class FlatParamVector:
+    """A parameter tree flattened into one contiguous 1-D buffer.
+
+    The ComponentArray analogue: ``synchronize`` (and any collective) touches
+    the single flat vector — one collective for the whole tree instead of one
+    per leaf (reference: ext/FluxMPIComponentArraysExt.jl:6-9).
+    """
+
+    def __init__(self, flat: jax.Array, shapes, treedef, sizes) -> None:
+        self.flat = flat
+        self._shapes = shapes
+        self._treedef = treedef
+        self._sizes = sizes
+
+    @classmethod
+    def from_tree(cls, tree: Any) -> "FlatParamVector":
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        shapes = [jnp.shape(l) for l in leaves]
+        sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+        flat = (
+            jnp.concatenate([jnp.ravel(jnp.asarray(l)) for l in leaves])
+            if leaves
+            else jnp.zeros((0,))
+        )
+        return cls(flat, shapes, treedef, sizes)
+
+    def to_tree(self) -> Any:
+        leaves = []
+        offset = 0
+        for shape, size in zip(self._shapes, self._sizes):
+            leaves.append(jnp.reshape(self.flat[offset : offset + size], shape))
+            offset += size
+        return jax.tree_util.tree_unflatten(self._treedef, leaves)
+
+    def __len__(self) -> int:
+        return int(self.flat.shape[0])
+
+
+def _fpv_flatten(v: FlatParamVector):
+    return (v.flat,), (v._shapes, v._treedef, v._sizes)
+
+
+def _fpv_unflatten(aux, children):
+    shapes, treedef, sizes = aux
+    return FlatParamVector(children[0], shapes, treedef, sizes)
+
+
+jax.tree_util.register_pytree_node(FlatParamVector, _fpv_flatten, _fpv_unflatten)

@@ -1,0 +1,155 @@
+"""Collective tests (reference: test/test_mpi_extensions.jl).
+
+Per-worker values are arrays with leading axis == world size, one slice per
+device — the mesh analogue of each MPI rank's local buffer. Oracles are the
+reference's: sum-allreduce scales by world size, prod-allreduce of ones is
+identity, bcast propagates the root pattern, reduce updates only root.
+"""
+
+import numpy as np
+import pytest
+
+
+def _rank_values(nworkers, shape=(4,), root_val=1.0, other_val=0.0, root=0):
+    """Rank-dependent fixture: root slice = ones, others = zeros
+    (reference: test/test_synchronize.jl:5-11)."""
+    x = np.full((nworkers, *shape), other_val, dtype=np.float32)
+    x[root] = root_val
+    return x
+
+
+def test_allreduce_sum(world, nworkers):
+    # reference: test/test_mpi_extensions.jl — allreduce(+) == x * nworkers
+    import fluxmpi_tpu as fm
+
+    x = np.ones((nworkers, 4), dtype=np.float32)
+    out = fm.unshard_ranks(fm.allreduce(x, "+"))
+    np.testing.assert_allclose(out, np.full((nworkers, 4), nworkers))
+
+
+def test_allreduce_sum_distinct_ranks(world, nworkers):
+    x = np.arange(nworkers * 3, dtype=np.float32).reshape(nworkers, 3)
+    out = fm_unshard(fm_allreduce(x, "sum"))
+    expected = np.broadcast_to(x.sum(axis=0), (nworkers, 3))
+    np.testing.assert_allclose(out, expected)
+
+
+def test_allreduce_prod_identity(world, nworkers):
+    # reference: Iallreduce! with * on ones → identity
+    import fluxmpi_tpu as fm
+
+    x = np.ones((nworkers, 5), dtype=np.float32)
+    out = fm.unshard_ranks(fm.allreduce(x, "*"))
+    np.testing.assert_allclose(out, np.ones((nworkers, 5)))
+
+
+def test_allreduce_min_max(world, nworkers):
+    import fluxmpi_tpu as fm
+
+    x = np.arange(nworkers, dtype=np.float32).reshape(nworkers, 1)
+    np.testing.assert_allclose(fm.unshard_ranks(fm.allreduce(x, "min")), 0.0)
+    np.testing.assert_allclose(
+        fm.unshard_ranks(fm.allreduce(x, "max")), float(nworkers - 1)
+    )
+
+
+def test_allreduce_mean(world, nworkers):
+    import fluxmpi_tpu as fm
+
+    x = np.arange(nworkers, dtype=np.float32).reshape(nworkers, 1)
+    np.testing.assert_allclose(
+        fm.unshard_ranks(fm.allreduce(x, "mean")),
+        np.full((nworkers, 1), x.mean()),
+    )
+
+
+def test_bcast_root_pattern(world, nworkers):
+    # reference: test/test_mpi_extensions.jl:25-32 — root ones propagate
+    import fluxmpi_tpu as fm
+
+    for root in (0, nworkers - 1):
+        x = _rank_values(nworkers, root_val=1.0, other_val=0.0, root=root)
+        out = fm.unshard_ranks(fm.bcast(x, root))
+        np.testing.assert_allclose(out, np.ones((nworkers, 4)))
+
+
+def test_reduce_root_only(world, nworkers):
+    # reference: test/test_mpi_extensions.jl:34-62 — root gets the sum,
+    # non-root slices keep their input
+    import fluxmpi_tpu as fm
+
+    x = np.ones((nworkers, 4), dtype=np.float32)
+    out = fm.unshard_ranks(fm.reduce(x, "+", 0))
+    np.testing.assert_allclose(out[0], np.full(4, nworkers))
+    np.testing.assert_allclose(out[1:], np.ones((nworkers - 1, 4)))
+
+
+def test_nonblocking_wrappers(world, nworkers):
+    # reference: Iallreduce!/Ibcast! return (buffer, request); wait completes
+    import fluxmpi_tpu as fm
+
+    x = np.ones((nworkers, 2), dtype=np.float32)
+    out, req = fm.iallreduce(x, "+")
+    val = req.wait()
+    np.testing.assert_allclose(np.asarray(val), np.full((nworkers, 2), nworkers))
+
+    y = _rank_values(nworkers, shape=(2,))
+    out, req = fm.ibcast(y, 0)
+    fm.Request.wait_all([req])
+    np.testing.assert_allclose(np.asarray(out), np.ones((nworkers, 2)))
+
+
+def test_bad_op_rejected(world):
+    import fluxmpi_tpu as fm
+
+    with pytest.raises(ValueError):
+        fm.allreduce(np.ones((8, 2)), "xor")
+
+
+def test_bad_shape_rejected(world):
+    import fluxmpi_tpu as fm
+
+    with pytest.raises(ValueError):
+        fm.allreduce(np.ones((3, 2)), "+")
+
+
+def test_cpu_device_helpers(world):
+    import jax.numpy as jnp
+
+    import fluxmpi_tpu as fm
+
+    x = jnp.ones((4,))
+    h = fm.cpu(x)
+    assert isinstance(h, np.ndarray)
+    d = fm.device(h)
+    assert hasattr(d, "sharding")
+    # identity on non-arrays (reference: src/mpi_extensions.jl:5-8)
+    assert fm.cpu("hello") == "hello"
+    assert fm.device(None) is None
+
+
+def test_barrier_noop(world):
+    import fluxmpi_tpu as fm
+
+    fm.barrier()
+
+
+def test_host_collectives_single_process(world):
+    import fluxmpi_tpu as fm
+
+    x = np.arange(4.0)
+    np.testing.assert_allclose(fm.host_allreduce(x), x)
+    np.testing.assert_allclose(fm.host_bcast(x), x)
+
+
+# Helpers so a couple of tests read tighter.
+def fm_allreduce(x, op):
+    import fluxmpi_tpu as fm
+
+    return fm.allreduce(x, op)
+
+
+def fm_unshard(x):
+    import fluxmpi_tpu as fm
+
+    return fm.unshard_ranks(x)

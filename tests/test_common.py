@@ -1,0 +1,89 @@
+"""Runtime + logging tests (reference: test/test_common.jl)."""
+
+import pytest
+
+
+def test_not_initialized_error():
+    import fluxmpi_tpu as fm
+    from fluxmpi_tpu import runtime
+
+    was_init = runtime.is_initialized()
+    saved_mesh = runtime._state.mesh
+    try:
+        runtime.shutdown()
+        assert not fm.is_initialized()
+        with pytest.raises(fm.FluxMPINotInitializedError):
+            fm.local_rank()
+        with pytest.raises(fm.FluxMPINotInitializedError):
+            fm.total_workers()
+    finally:
+        if was_init:
+            runtime._state.initialized = True
+            runtime._state.mesh = saved_mesh
+
+
+def test_init_idempotent(world):
+    import fluxmpi_tpu as fm
+
+    mesh1 = fm.init()
+    mesh2 = fm.init(verbose=True)
+    assert mesh1 is mesh2
+    assert fm.is_initialized()
+    assert fm.Initialized()
+
+
+def test_world_identity(world):
+    # reference: test/test_common.jl asserts local_rank() < total_workers()
+    import fluxmpi_tpu as fm
+
+    assert fm.total_workers() == 8
+    assert 0 <= fm.local_rank() < fm.total_workers()
+    assert fm.process_count() == 1
+    assert fm.device_count() == 8
+    assert fm.local_device_count() == 8
+
+
+def test_mesh_shape(world):
+    import fluxmpi_tpu as fm
+
+    mesh = fm.global_mesh()
+    assert mesh.shape == {fm.dp_axis_name(): 8}
+
+
+def test_custom_mesh_shape_inference():
+    import fluxmpi_tpu as fm
+    from fluxmpi_tpu import runtime
+
+    saved = (runtime._state.initialized, runtime._state.mesh)
+    try:
+        runtime.shutdown()
+        mesh = fm.init(mesh_shape={"dp": -1, "sp": 2})
+        assert mesh.shape == {"dp": 4, "sp": 2}
+    finally:
+        runtime._state.initialized, runtime._state.mesh = saved
+
+
+def test_print_functions(world, capsys):
+    # reference: test/test_common.jl:6-13 — print fns run without error
+    import fluxmpi_tpu as fm
+
+    fm.fluxmpi_println("hello", "world")
+    fm.fluxmpi_print("partial")
+    out = capsys.readouterr().out
+    assert "hello" in out and "partial" in out
+
+
+def test_print_before_init(capsys):
+    from fluxmpi_tpu import runtime
+    from fluxmpi_tpu.logging import fluxmpi_println
+
+    saved = (runtime._state.initialized, runtime._state.mesh)
+    try:
+        runtime.shutdown()
+        fluxmpi_println("pre-init message")
+        out = capsys.readouterr().out
+        assert "pre-init message" in out
+        # timestamp prefix present pre-init (reference: src/common.jl:76-79)
+        assert out[:4].isdigit()
+    finally:
+        runtime._state.initialized, runtime._state.mesh = saved
