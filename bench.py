@@ -1,0 +1,141 @@
+"""Benchmark harness: prints ONE JSON line with the headline metric.
+
+Flagship workload (BASELINE.md): ResNet-50 synthetic-ImageNet DP training
+throughput in images/sec/chip. Until the ResNet model lands, falls back to
+the quick-start MLP regression step (BASELINE config 1).
+
+``vs_baseline`` context: the reference publishes no numbers
+(BASELINE.md "published: {}"), so the ratio is reported against this repo's
+own recorded target where one exists, else 1.0.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _bench_resnet50():  # pragma: no cover - requires model
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import fluxmpi_tpu as fm
+    from fluxmpi_tpu.models import ResNet50  # type: ignore[attr-defined]
+    from fluxmpi_tpu.parallel import TrainState, make_train_step
+    from fluxmpi_tpu.parallel.train import replicate, shard_batch
+
+    mesh = fm.init()
+    n_dev = fm.total_workers()
+    per_chip_batch = 64
+    batch = per_chip_batch * n_dev
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+
+    x = jnp.ones((batch, 224, 224, 3), jnp.bfloat16)
+    y = jnp.zeros((batch,), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), x[:2], train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats")
+
+    optimizer = optax.sgd(0.1, momentum=0.9)
+
+    def loss_fn(p, mstate, b):
+        bx, by = b
+        logits, updates = model.apply(
+            {"params": p, "batch_stats": mstate},
+            bx,
+            train=True,
+            mutable=["batch_stats"],
+        )
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), by
+        ).mean()
+        return loss, updates["batch_stats"]
+
+    step = make_train_step(loss_fn, optimizer, mesh=mesh, style="auto")
+    state = replicate(TrainState.create(params, optimizer, batch_stats), mesh)
+    data = shard_batch((x, y), mesh)
+
+    for _ in range(3):  # warmup + compile
+        state, loss = step(state, data)
+    jax.block_until_ready(loss)
+
+    steps = 20
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss = step(state, data)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec_chip = batch * steps / dt / n_dev
+    return {
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(imgs_per_sec_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": 1.0,
+    }
+
+
+def _bench_mlp():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import fluxmpi_tpu as fm
+    from fluxmpi_tpu.models import MLP
+    from fluxmpi_tpu.parallel import TrainState, make_train_step
+    from fluxmpi_tpu.parallel.train import replicate, shard_batch
+
+    mesh = fm.init()
+    n_dev = fm.total_workers()
+    batch = 8192 * n_dev
+    model = MLP(features=(256, 256, 256, 1))
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.uniform(-2, 2, size=(batch, 1)).astype(np.float32))
+    y = x**2
+
+    params = model.init(jax.random.PRNGKey(0), x[:2])
+    optimizer = optax.adam(1e-3)
+
+    def loss_fn(p, mstate, b):
+        bx, by = b
+        return jnp.mean((model.apply(p, bx) - by) ** 2), mstate
+
+    step = make_train_step(loss_fn, optimizer, mesh=mesh, style="auto")
+    state = replicate(TrainState.create(params, optimizer), mesh)
+    data = shard_batch((x, y), mesh)
+
+    for _ in range(3):
+        state, loss = step(state, data)
+    jax.block_until_ready(loss)
+
+    steps = 50
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss = step(state, data)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    return {
+        "metric": "mlp_quickstart_samples_per_sec_per_chip",
+        "value": round(batch * steps / dt / n_dev, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": 1.0,
+    }
+
+
+def main() -> None:
+    try:
+        from fluxmpi_tpu.models import ResNet50  # noqa: F401
+
+        result = _bench_resnet50()
+    except ImportError:
+        result = _bench_mlp()
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

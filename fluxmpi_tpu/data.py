@@ -86,10 +86,11 @@ class DistributedDataContainer:
 
     def min_shard_size(self) -> int:
         """Size of the smallest shard in this container's world (the last
-        rank's remainder shard) — every process can serve at least this many
-        samples, which keeps multi-process iteration in lockstep."""
+        rank's remainder shard, or 0 when trailing ranks have empty shards)
+        — every process can serve at least this many samples, which keeps
+        multi-process iteration in lockstep."""
         spp = math.ceil(self.total_size / self.world)
-        return self.total_size - (self.world - 1) * spp
+        return max(0, self.total_size - (self.world - 1) * spp)
 
     def __len__(self) -> int:
         return len(self.idxs)  # reference: src/data.jl:24
@@ -186,6 +187,21 @@ class DistributedDataLoader:
             )
         else:
             self._common_len = len(data)
+        if not drop_last:
+            remainder = self._common_len % self.local_batch_size
+            global_remainder = remainder * jax.process_count()
+            axis_size = (
+                mesh_for_check.shape.get(self.axis_name, 1)
+                if mesh_for_check is not None
+                else 1
+            )
+            if global_remainder % axis_size != 0:
+                raise ValueError(
+                    f"drop_last=False leaves a final batch of "
+                    f"{global_remainder} samples, not divisible by the "
+                    f"'{self.axis_name}' mesh axis size {axis_size}; use "
+                    f"drop_last=True or pad the dataset"
+                )
 
     def __len__(self) -> int:
         if self.drop_last:

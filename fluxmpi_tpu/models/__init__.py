@@ -1,0 +1,10 @@
+"""Model zoo mirroring the reference's benchmark configs (BASELINE.md):
+
+1. :class:`MLP` — README quick-start 4-layer perceptron.
+2. :class:`CNN` — Conv+BatchNorm CIFAR-10 net.
+3. :class:`ResNet50` — the headline ImageNet DP workload.
+4. :class:`DEQ` — deep equilibrium model with implicit-gradient custom VJP.
+5. :class:`TransformerEncoder` — the wrapped-model adapter path.
+"""
+
+from .mlp import MLP  # noqa: F401

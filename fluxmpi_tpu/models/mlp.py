@@ -1,0 +1,27 @@
+"""Quick-start MLP (BASELINE config 1).
+
+The reference README's example model: a 4-layer Dense chain regressing
+``y = x^2`` (reference: README.md:31-41 — Dense(1→16, gelu) ×2 hidden,
+Dense(16→1)). Built as a flax.linen module; widths configurable.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    """Dense chain with gelu hidden activations (reference README.md:35-38)."""
+
+    features: Sequence[int] = (16, 16, 16, 1)
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        for i, width in enumerate(self.features):
+            x = nn.Dense(width, name=f"dense_{i}")(x)
+            if i < len(self.features) - 1:
+                x = nn.gelu(x)
+        return x

@@ -1,0 +1,10 @@
+"""Parallelism utilities: in-jit collectives, mesh helpers, train-step
+factories, and sequence parallelism."""
+
+from .collectives import (  # noqa: F401
+    pallreduce,
+    pbroadcast,
+    pmean_tree,
+    psum_tree,
+)
+from .train import TrainState, make_train_step  # noqa: F401

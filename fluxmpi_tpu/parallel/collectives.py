@@ -1,0 +1,57 @@
+"""In-jit collective helpers for use inside ``shard_map``/``pjit`` bodies.
+
+The compiled-side counterpart of the eager layer in :mod:`fluxmpi_tpu.comm`:
+where the reference issues host-driven MPI calls per array
+(reference: src/mpi_extensions.jl), code inside a compiled TPU step calls
+these thin wrappers and XLA schedules the collectives (async, overlapped with
+compute) over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from .. import config
+
+__all__ = ["psum_tree", "pmean_tree", "pallreduce", "pbroadcast"]
+
+
+def psum_tree(tree: Any, axis_name: str | None = None) -> Any:
+    """Sum a pytree across a bound mesh axis (compiled analogue of the
+    reference's per-leaf ``allreduce!(+)``, src/optimizer.jl:20-21)."""
+    return jax.lax.psum(tree, axis_name or config.DP_AXIS_NAME)
+
+
+def pmean_tree(tree: Any, axis_name: str | None = None) -> Any:
+    """Mean-reduce a pytree across a bound mesh axis."""
+    return jax.lax.pmean(tree, axis_name or config.DP_AXIS_NAME)
+
+
+def pallreduce(x: Any, op: str = "sum", axis_name: str | None = None) -> Any:
+    """All-reduce with a named op inside a compiled step."""
+    name = axis_name or config.DP_AXIS_NAME
+    if op in ("sum", "+"):
+        return jax.lax.psum(x, name)
+    if op in ("mean", "avg"):
+        return jax.lax.pmean(x, name)
+    if op == "max":
+        return jax.lax.pmax(x, name)
+    if op == "min":
+        return jax.lax.pmin(x, name)
+    raise ValueError(f"unsupported in-jit reduction {op!r}")
+
+
+def pbroadcast(x: Any, root: int = 0, axis_name: str | None = None) -> Any:
+    """Broadcast the root worker's value across a bound mesh axis (compiled
+    analogue of ``bcast!``, reference src/mpi_extensions.jl:119-133)."""
+    import jax.numpy as jnp
+
+    name = axis_name or config.DP_AXIS_NAME
+
+    def _bcast_leaf(leaf):
+        gathered = jax.lax.all_gather(leaf, name)
+        return jnp.take(gathered, root, axis=0)
+
+    return jax.tree_util.tree_map(_bcast_leaf, x)

@@ -1,0 +1,213 @@
+"""Compiled data-parallel train-step factories.
+
+This is where the reference's training-loop integration
+(reference: README.md:31-70 — Zygote pullback, per-leaf allreduce via
+``DistributedOptimizer``/``allreduce_gradients``, ``Optimisers.update``)
+becomes ONE compiled XLA program per step: forward, backward, gradient
+all-reduce over ICI, and optimizer update fused and scheduled together, with
+buffer donation so parameters update in place in HBM.
+
+Two styles, same math:
+
+- ``style="auto"`` (default, fastest): the step is jitted with explicit
+  shardings — state replicated, batch laid out over the data-parallel axis —
+  and XLA's SPMD partitioner inserts and overlaps the gradient reduction.
+  The loss function sees the *global* batch.
+- ``style="shard_map"`` (explicit, reference-shaped): the step body runs
+  per-device on the local batch shard and calls the collective explicitly
+  (``psum``/``pmean`` — the compiled analogue of the reference's
+  ``allreduce_gradients``, src/optimizer.jl:45-65). Use this when you want
+  manual control, e.g. collectives inside custom VJPs.
+
+Gradient semantics default to ``grad_reduce="mean"`` (the mathematically
+data-parallel-correct average). The reference's sum-then-user-scales
+convention (src/optimizer.jl:11-14) is available as ``grad_reduce="sum"``;
+pass ``grad_reduce=None`` if your optimizer already reduces (e.g. a
+``DistributedOptimizer(axis_name=...)``) so gradients aren't reduced twice.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import config
+from ..runtime import global_mesh
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+__all__ = ["TrainState", "make_train_step", "replicate", "shard_batch"]
+
+
+class TrainState(flax.struct.PyTreeNode):
+    """Replicated training state: parameters, optimizer state, and mutable
+    model state (e.g. BatchNorm batch_stats). A pure pytree — safe to
+    donate, checkpoint, and synchronize."""
+
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    model_state: Any = None
+
+    @classmethod
+    def create(
+        cls,
+        params: Any,
+        optimizer: optax.GradientTransformation,
+        model_state: Any = None,
+    ) -> "TrainState":
+        return cls(
+            step=jnp.zeros((), dtype=jnp.int32),
+            params=params,
+            opt_state=optimizer.init(params),
+            model_state=model_state,
+        )
+
+
+def replicate(tree: Any, mesh: Mesh | None = None) -> Any:
+    """Lay a pytree out replicated over the mesh (every device holds the
+    full value) — the device-level completion of :func:`synchronize`."""
+    mesh = mesh or global_mesh()
+    sharding = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(jnp.asarray(x), sharding), tree
+    )
+
+
+def shard_batch(batch: Any, mesh: Mesh | None = None, axis_name: str | None = None) -> Any:
+    """Lay a host batch out sharded over the data-parallel axis."""
+    mesh = mesh or global_mesh()
+    name = axis_name or config.DP_AXIS_NAME
+    sharding = NamedSharding(mesh, P(name))
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def make_train_step(
+    loss_fn: Callable[[Any, Any, Any], tuple[jax.Array, Any]],
+    optimizer: optax.GradientTransformation,
+    *,
+    mesh: Mesh | None = None,
+    axis_name: str | None = None,
+    style: str = "auto",
+    grad_reduce: str | None = "mean",
+    state_reduce: str = "mean",
+    donate: bool | None = None,
+) -> Callable[[TrainState, Any], tuple[TrainState, jax.Array]]:
+    """Build a compiled data-parallel train step.
+
+    Args:
+      loss_fn: ``loss_fn(params, model_state, batch) -> (loss, new_model_state)``.
+        Stateless models return ``None`` as the new state. Under
+        ``style="auto"`` it sees the global batch; under ``style="shard_map"``
+        the per-device shard.
+      optimizer: any optax transformation (plain — see ``grad_reduce=None``
+        for pre-reducing optimizers like ``DistributedOptimizer``).
+      mesh: defaults to the runtime's global mesh.
+      axis_name: data-parallel axis (default from config).
+      style: ``"auto"`` (XLA SPMD partitioner inserts collectives) or
+        ``"shard_map"`` (explicit per-device body + psum/pmean).
+      grad_reduce: ``"mean"`` | ``"sum"`` | ``None`` (no reduction here).
+        Only meaningful for ``style="shard_map"``; under ``"auto"`` the
+        partitioner derives the reduction from the shardings.
+      state_reduce: how to combine per-device mutable model state under
+        ``shard_map`` (``"mean"`` for BatchNorm-style running stats, or
+        ``"local"`` to keep replica-local values — the reference never
+        reduces state during training, syncing only at init,
+        SURVEY.md §7 hard parts).
+      donate: donate the TrainState buffers (in-place update in HBM).
+        Defaults to the ``donate_buffers`` preference.
+
+    Returns:
+      ``step(state, batch) -> (new_state, loss)`` — compiled, collective
+      communication included; call it in a plain Python loop.
+    """
+    mesh = mesh or global_mesh()
+    name = axis_name or config.DP_AXIS_NAME
+    if donate is None:
+        donate = bool(config.load_preference("donate_buffers"))
+    if style not in ("auto", "shard_map"):
+        raise ValueError("style must be 'auto' or 'shard_map'")
+    if grad_reduce not in ("mean", "sum", None):
+        raise ValueError("grad_reduce must be 'mean', 'sum', or None")
+
+    grad_and_aux = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def _apply_update(ts: TrainState, grads, loss, new_mstate):
+        updates, opt_state = optimizer.update(grads, ts.opt_state, ts.params)
+        params = optax.apply_updates(ts.params, updates)
+        return (
+            TrainState(
+                step=ts.step + 1,
+                params=params,
+                opt_state=opt_state,
+                model_state=new_mstate,
+            ),
+            loss,
+        )
+
+    if style == "auto":
+
+        def step(ts: TrainState, batch):
+            (loss, new_mstate), grads = grad_and_aux(
+                ts.params, ts.model_state, batch
+            )
+            return _apply_update(ts, grads, loss, new_mstate)
+
+        replicated = NamedSharding(mesh, P())
+        batch_sharding = NamedSharding(mesh, P(name))
+        return jax.jit(
+            step,
+            in_shardings=(replicated, batch_sharding),
+            out_shardings=(replicated, replicated),
+            donate_argnums=(0,) if donate else (),
+        )
+
+    # style == "shard_map": explicit per-device body. NOTE: shard_map's
+    # replication checker (check_vma) auto-inserts a psum on the cotangent
+    # of replicated inputs, which would pre-reduce the gradients and make
+    # the explicit collectives below double-count. Disable it so gradients
+    # stay device-local until the explicit reduction — the reference's
+    # "each rank holds local grads, then allreduce" model
+    # (src/optimizer.jl:45-65).
+    def step_body(ts: TrainState, batch):
+        (loss, new_mstate), grads = grad_and_aux(ts.params, ts.model_state, batch)
+        if grad_reduce == "mean":
+            grads = jax.lax.pmean(grads, name)
+            loss = jax.lax.pmean(loss, name)
+        elif grad_reduce == "sum":
+            grads = jax.lax.psum(grads, name)
+            loss = jax.lax.psum(loss, name)
+        if new_mstate is not None and state_reduce == "mean":
+            new_mstate = jax.tree_util.tree_map(
+                lambda s: jax.lax.pmean(s, name)
+                if jnp.issubdtype(jnp.asarray(s).dtype, jnp.inexact)
+                else s,
+                new_mstate,
+            )
+        return _apply_update(ts, grads, loss, new_mstate)
+
+    try:
+        mapped = shard_map(
+            step_body,
+            mesh=mesh,
+            in_specs=(P(), P(name)),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+    except TypeError:  # pragma: no cover - older jax spells it check_rep
+        mapped = shard_map(
+            step_body,
+            mesh=mesh,
+            in_specs=(P(), P(name)),
+            out_specs=(P(), P()),
+            check_rep=False,
+        )
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())

@@ -70,9 +70,9 @@ def _sync_leaf(x: Any, root_rank: int) -> Any:
     if isinstance(x, (jax.Array,)) or (
         isinstance(x, np.ndarray) and x.dtype != object
     ):
-        if np.issubdtype(np.asarray(jax.device_get(x)).dtype, np.number) or np.issubdtype(
-            np.asarray(jax.device_get(x)).dtype, np.bool_
-        ):
+        # dtype is available without a device→host transfer on both kinds.
+        dtype = np.dtype(x.dtype) if isinstance(x, np.ndarray) else x.dtype
+        if np.issubdtype(dtype, np.number) or np.issubdtype(dtype, np.bool_):
             return _sync_array(x, root_rank)
         return x
     if isinstance(x, np.ndarray) and x.dtype == object:
@@ -165,29 +165,34 @@ class FlatParamVector:
     per leaf (reference: ext/FluxMPIComponentArraysExt.jl:6-9).
     """
 
-    def __init__(self, flat: jax.Array, shapes, treedef, sizes) -> None:
+    def __init__(self, flat: jax.Array, shapes, treedef, sizes, dtypes=None) -> None:
         self.flat = flat
         self._shapes = shapes
         self._treedef = treedef
         self._sizes = sizes
+        self._dtypes = dtypes
 
     @classmethod
     def from_tree(cls, tree: Any) -> "FlatParamVector":
         leaves, treedef = jax.tree_util.tree_flatten(tree)
         shapes = [jnp.shape(l) for l in leaves]
         sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+        dtypes = [jnp.asarray(l).dtype for l in leaves]
         flat = (
             jnp.concatenate([jnp.ravel(jnp.asarray(l)) for l in leaves])
             if leaves
             else jnp.zeros((0,))
         )
-        return cls(flat, shapes, treedef, sizes)
+        return cls(flat, shapes, treedef, sizes, dtypes)
 
     def to_tree(self) -> Any:
         leaves = []
         offset = 0
-        for shape, size in zip(self._shapes, self._sizes):
-            leaves.append(jnp.reshape(self.flat[offset : offset + size], shape))
+        dtypes = self._dtypes or [self.flat.dtype] * len(self._sizes)
+        for shape, size, dtype in zip(self._shapes, self._sizes, dtypes):
+            leaves.append(
+                jnp.reshape(self.flat[offset : offset + size], shape).astype(dtype)
+            )
             offset += size
         return jax.tree_util.tree_unflatten(self._treedef, leaves)
 
@@ -196,12 +201,12 @@ class FlatParamVector:
 
 
 def _fpv_flatten(v: FlatParamVector):
-    return (v.flat,), (v._shapes, v._treedef, v._sizes)
+    return (v.flat,), (v._shapes, v._treedef, v._sizes, v._dtypes)
 
 
 def _fpv_unflatten(aux, children):
-    shapes, treedef, sizes = aux
-    return FlatParamVector(children[0], shapes, treedef, sizes)
+    shapes, treedef, sizes, dtypes = aux
+    return FlatParamVector(children[0], shapes, treedef, sizes, dtypes)
 
 
 jax.tree_util.register_pytree_node(FlatParamVector, _fpv_flatten, _fpv_unflatten)
