@@ -12,9 +12,25 @@ own recorded target where one exists, else 1.0.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
+
+
+def _enable_compilation_cache() -> None:
+    """Persist compiled XLA programs so repeat bench runs skip the (slow)
+    first compile."""
+    import jax
+
+    cache_dir = os.environ.get(
+        "FLUXMPI_TPU_COMPILE_CACHE", "/tmp/fluxmpi_tpu_xla_cache"
+    )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
 
 
 def _bench_resnet50():  # pragma: no cover - requires model
@@ -128,6 +144,7 @@ def _bench_mlp():
 
 
 def main() -> None:
+    _enable_compilation_cache()
     try:
         from fluxmpi_tpu.models import ResNet50  # noqa: F401
 

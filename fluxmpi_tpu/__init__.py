@@ -58,4 +58,8 @@ __version__ = "0.1.0"
 # cheap and define the rest of the public API.
 from .sync import synchronize, FluxModelWrapper, FlatParamVector  # noqa: F401,E402
 from .optimizer import DistributedOptimizer, allreduce_gradients  # noqa: F401,E402
-from .data import DistributedDataContainer, DistributedDataLoader  # noqa: F401,E402
+from .data import (  # noqa: F401,E402
+    ArrayDataset,
+    DistributedDataContainer,
+    DistributedDataLoader,
+)

@@ -30,7 +30,34 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from . import config
 from .runtime import global_mesh
 
-__all__ = ["DistributedDataContainer", "DistributedDataLoader"]
+__all__ = ["ArrayDataset", "DistributedDataContainer", "DistributedDataLoader"]
+
+
+class ArrayDataset:
+    """A dataset backed by a pytree of equal-length host arrays.
+
+    Samples are ``tree_map(lambda a: a[i], arrays)``. Loaders recognize this
+    type (including wrapped in a :class:`DistributedDataContainer`) and
+    assemble batches with the native C++ thread-pool gather
+    (:mod:`fluxmpi_tpu.io`) instead of per-sample Python indexing.
+    """
+
+    def __init__(self, arrays: Any):
+        leaves = jax.tree_util.tree_leaves(arrays)
+        if not leaves:
+            raise ValueError("ArrayDataset needs at least one array")
+        n = len(leaves[0])
+        for leaf in leaves:
+            if len(leaf) != n:
+                raise ValueError("all arrays must share the leading dimension")
+        self.arrays = jax.tree_util.tree_map(np.ascontiguousarray, arrays)
+        self._n = n
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __getitem__(self, i: int) -> Any:
+        return jax.tree_util.tree_map(lambda a: a[i], self.arrays)
 
 
 def _shard_bounds(total_size: int, rank: int, world: int) -> range:
@@ -212,6 +239,17 @@ class DistributedDataLoader:
         mesh = self.mesh or global_mesh()
         return NamedSharding(mesh, P(self.axis_name))
 
+    def _array_backing(self) -> tuple[Any, int] | None:
+        """If the dataset is array-backed, return (array pytree, index
+        offset) for the native gather fast path."""
+        if isinstance(self.data, ArrayDataset):
+            return self.data.arrays, 0
+        if isinstance(self.data, DistributedDataContainer) and isinstance(
+            self.data.data, ArrayDataset
+        ):
+            return self.data.data.arrays, self.data.idxs.start
+        return None
+
     def __iter__(self) -> Iterator[Any]:
         n = len(self.data)
         order = np.arange(n)
@@ -222,12 +260,38 @@ class DistributedDataLoader:
         sharding = self._sharding()
 
         nbatches = len(self)
-        for b in range(nbatches):
-            idxs = order[b * self.local_batch_size : (b + 1) * self.local_batch_size]
-            batch = _stack_samples([self.data[int(i)] for i in idxs])
-            yield jax.tree_util.tree_map(
+        backing = self._array_backing()
+
+        def _globalize(batch):
+            return jax.tree_util.tree_map(
                 lambda x: jax.make_array_from_process_local_data(
                     sharding, np.asarray(x)
                 ),
                 batch,
             )
+
+        if backing is not None:
+            # Native fast path: one C++ prefetcher per array leaf assembles
+            # the next batches on background threads while the device runs
+            # the current step.
+            from .io import NativePrefetcher
+
+            arrays, offset = backing
+            lbs = self.local_batch_size
+            epoch_order = order[: nbatches * lbs] + offset
+            leaves, treedef = jax.tree_util.tree_flatten(arrays)
+            prefetchers = [
+                iter(NativePrefetcher(leaf, epoch_order, lbs))
+                for leaf in leaves
+            ]
+            for leaf_batches in zip(*prefetchers):
+                batch = jax.tree_util.tree_unflatten(
+                    treedef, list(leaf_batches)
+                )
+                yield _globalize(batch)
+            return
+
+        for b in range(nbatches):
+            idxs = order[b * self.local_batch_size : (b + 1) * self.local_batch_size]
+            batch = _stack_samples([self.data[int(i)] for i in idxs])
+            yield _globalize(batch)

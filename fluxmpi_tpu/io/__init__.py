@@ -1,0 +1,7 @@
+"""Native input-pipeline runtime (C++ thread-pool gather + prefetch)."""
+
+from .native import (  # noqa: F401
+    NativePrefetcher,
+    gather_rows,
+    native_available,
+)

@@ -1,0 +1,243 @@
+"""ctypes bindings for the native data-pipeline runtime.
+
+Builds ``native_loader.cpp`` into a shared library on first use (plain
+``g++ -O3 -shared`` — no pybind11 in the image, so the ABI is C and the
+binding is ctypes) and exposes:
+
+- :func:`gather_rows` — multithreaded gather of scattered dataset rows into
+  one contiguous batch buffer (the hot host-side op of batch assembly);
+- :class:`NativePrefetcher` — a bounded producer/consumer queue building
+  the next batches on C++ threads while the device runs the current step.
+
+Everything degrades gracefully to numpy when the toolchain is unavailable
+(``native_available()`` → False).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+import warnings
+
+import numpy as np
+
+__all__ = ["native_available", "gather_rows", "NativePrefetcher"]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "native_loader.cpp")
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_build_failed = False
+
+
+def _lib_path() -> str:
+    """Per-host build location.
+
+    The package directory may be shared across heterogeneous hosts (NFS in a
+    multihost pod), and the build uses ``-march=native`` — so the cached
+    artifact must be keyed by host, not stored in the package. Build into
+    the local temp dir with a host/arch discriminator; an incompatible
+    binary from another machine can then never be loaded.
+    """
+    import hashlib
+    import platform
+    import tempfile
+
+    key = hashlib.sha1(
+        f"{platform.node()}|{platform.machine()}|{os.path.getmtime(_SRC)}".encode()
+    ).hexdigest()[:16]
+    return os.path.join(
+        tempfile.gettempdir(), f"fluxmpi_native_loader_{key}.so"
+    )
+
+
+def _build(lib_path: str) -> bool:
+    # Write to a unique temp name then rename: two processes racing the
+    # build never leave a torn .so at the final path.
+    tmp_path = f"{lib_path}.{os.getpid()}.tmp"
+    cmd = [
+        "g++",
+        "-O3",
+        "-march=native",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        "-pthread",
+        _SRC,
+        "-o",
+        tmp_path,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp_path, lib_path)
+        return True
+    except Exception as e:  # pragma: no cover - toolchain-specific
+        warnings.warn(f"native loader build failed ({e}); using numpy fallback")
+        try:
+            os.unlink(tmp_path)
+        except OSError:
+            pass
+        return False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        lib_path = _lib_path()
+        if not os.path.exists(lib_path):
+            if not _build(lib_path):
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(lib_path)
+        except OSError:
+            # Stale/corrupt artifact: rebuild once, then give up to the
+            # numpy fallback rather than crashing mid-epoch.
+            if not _build(lib_path):
+                _build_failed = True
+                return None
+            try:
+                lib = ctypes.CDLL(lib_path)
+            except OSError as e:  # pragma: no cover
+                warnings.warn(f"native loader unusable ({e}); numpy fallback")
+                _build_failed = True
+                return None
+        lib.fm_gather.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_uint64,
+            ctypes.c_void_p,
+            ctypes.c_uint64,
+            ctypes.c_void_p,
+            ctypes.c_int,
+        ]
+        lib.fm_gather.restype = None
+        lib.fm_prefetch_create.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_uint64,
+            ctypes.c_void_p,
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+            ctypes.c_int,
+        ]
+        lib.fm_prefetch_create.restype = ctypes.c_void_p
+        lib.fm_prefetch_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.fm_prefetch_next.restype = ctypes.c_int64
+        lib.fm_prefetch_destroy.argtypes = [ctypes.c_void_p]
+        lib.fm_prefetch_destroy.restype = None
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    """Whether the C++ runtime is built (or buildable)."""
+    return _load() is not None
+
+
+def _as_2d_rows(array: np.ndarray) -> np.ndarray:
+    a = np.ascontiguousarray(array)
+    return a.reshape(a.shape[0], -1)
+
+
+def gather_rows(
+    array: np.ndarray, indices: np.ndarray, *, threads: int | None = None
+) -> np.ndarray:
+    """``array[indices]`` along axis 0, gathered by the C++ thread pool
+    (numpy fallback when the native library is unavailable)."""
+    lib = _load()
+    idx = np.ascontiguousarray(indices, dtype=np.int64)
+    if idx.size and (idx.min() < 0 or idx.max() >= len(array)):
+        # The C++ gather is a raw memcpy — bounds must be enforced here.
+        raise IndexError(
+            f"gather index out of range [0, {len(array)}): "
+            f"min={idx.min()}, max={idx.max()}"
+        )
+    if lib is None:
+        return array[idx]
+    a2 = _as_2d_rows(array)
+    out = np.empty((len(idx), a2.shape[1]), dtype=array.dtype)
+    row_bytes = a2.shape[1] * array.dtype.itemsize
+    lib.fm_gather(
+        a2.ctypes.data_as(ctypes.c_void_p),
+        row_bytes,
+        idx.ctypes.data_as(ctypes.c_void_p),
+        len(idx),
+        out.ctypes.data_as(ctypes.c_void_p),
+        threads or min(8, os.cpu_count() or 1),
+    )
+    return out.reshape((len(idx),) + array.shape[1:])
+
+
+class NativePrefetcher:
+    """Assemble the epoch's batches on background C++ threads.
+
+    Wraps one contiguous dataset array; ``__iter__`` yields gathered batch
+    arrays in epoch order while the next batches build concurrently.
+    """
+
+    def __init__(
+        self,
+        array: np.ndarray,
+        order: np.ndarray,
+        batch_rows: int,
+        *,
+        queue_capacity: int = 3,
+        threads: int | None = None,
+    ):
+        self._array = np.ascontiguousarray(array)
+        self._order = np.ascontiguousarray(order, dtype=np.int64)
+        if self._order.size and (
+            self._order.min() < 0 or self._order.max() >= len(array)
+        ):
+            raise IndexError(
+                f"order index out of range [0, {len(array)}): "
+                f"min={self._order.min()}, max={self._order.max()}"
+            )
+        self._batch_rows = int(batch_rows)
+        self._n_batches = len(self._order) // self._batch_rows
+        self._row_shape = array.shape[1:]
+        self._dtype = array.dtype
+        self._lib = _load()
+        self._handle = None
+        self._capacity = queue_capacity
+        self._threads = threads or min(8, os.cpu_count() or 1)
+
+    def __len__(self) -> int:
+        return self._n_batches
+
+    def __iter__(self):
+        if self._lib is None:
+            for b in range(self._n_batches):
+                idx = self._order[b * self._batch_rows : (b + 1) * self._batch_rows]
+                yield self._array[idx]
+            return
+        a2 = _as_2d_rows(self._array)
+        row_bytes = a2.shape[1] * self._dtype.itemsize
+        handle = self._lib.fm_prefetch_create(
+            a2.ctypes.data_as(ctypes.c_void_p),
+            row_bytes,
+            self._order.ctypes.data_as(ctypes.c_void_p),
+            len(self._order),
+            self._batch_rows,
+            self._capacity,
+            self._threads,
+        )
+        if not handle:
+            raise RuntimeError("fm_prefetch_create failed")
+        try:
+            for _ in range(self._n_batches):
+                out = np.empty(
+                    (self._batch_rows,) + self._row_shape, dtype=self._dtype
+                )
+                got = self._lib.fm_prefetch_next(
+                    handle, out.ctypes.data_as(ctypes.c_void_p)
+                )
+                if got < 0:
+                    return
+                yield out
+        finally:
+            self._lib.fm_prefetch_destroy(handle)

@@ -8,3 +8,13 @@
 """
 
 from .mlp import MLP  # noqa: F401
+from .cnn import CNN  # noqa: F401
+from .resnet import (  # noqa: F401
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+)
+from .deq import DEQ, fixed_point_solve  # noqa: F401
+from .transformer import TransformerEncoder, TransformerLM  # noqa: F401

@@ -1,0 +1,47 @@
+"""CIFAR-10 CNN with BatchNorm (BASELINE config 2).
+
+The reference benchmarks a Lux Conv+BatchNorm CNN on CIFAR-10 with
+``DistributedDataContainer`` sharding (BASELINE.md config 2); BatchNorm is
+the interesting part for DP — its running statistics are mutable model state
+that must be synchronized at init (the ``st`` sync path, reference
+README.md:44) and optionally cross-replica-reduced during training
+(SURVEY.md §7 hard parts).
+
+Pass ``axis_name`` to compute batch statistics across the data-parallel
+axis inside a ``shard_map`` step (sync-BN); under the ``"auto"`` train-step
+style, statistics are computed over the global batch by construction.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class CNN(nn.Module):
+    """Conv(3x3)-BN-relu ×3 with max-pooling, then Dense head."""
+
+    num_classes: int = 10
+    channels: tuple[int, ...] = (32, 64, 128)
+    dtype: jnp.dtype = jnp.float32
+    axis_name: str | None = None  # set for cross-replica (sync) BatchNorm
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = True) -> jnp.ndarray:
+        x = x.astype(self.dtype)
+        for i, ch in enumerate(self.channels):
+            x = nn.Conv(ch, (3, 3), padding="SAME", use_bias=False,
+                        dtype=self.dtype, name=f"conv_{i}")(x)
+            x = nn.BatchNorm(
+                use_running_average=not train,
+                momentum=0.9,
+                epsilon=1e-5,
+                dtype=self.dtype,
+                axis_name=self.axis_name if train else None,
+                name=f"bn_{i}",
+            )(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x

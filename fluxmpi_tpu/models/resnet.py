@@ -1,0 +1,128 @@
+"""ResNet-50 — the headline ImageNet DP workload (BASELINE config 3).
+
+The reference's metric workload is the Lux.jl ImageNet ResNet-50 example
+(reference: README.md:74-78; BASELINE.md: images/sec/chip at ≥70% DP scaling
+efficiency). Built TPU-first: NHWC layout, bf16 compute with f32 parameters
+and batch statistics, 3x3/1x1 convs sized to tile cleanly onto the MXU, and
+no data-dependent control flow so the whole step compiles to one XLA
+program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 → 3x3 → 1x1 bottleneck with projection shortcut on shape change."""
+
+    filters: int
+    strides: tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        residual = x
+        y = self.conv(self.filters, (1, 1), name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), self.strides, name="conv2")(y)
+        y = self.norm(name="bn2")(y)
+        y = self.act(y)
+        y = self.conv(4 * self.filters, (1, 1), name="conv3")(y)
+        # Zero-init the last BN scale so blocks start as identity — standard
+        # ResNet v1.5 trick, improves early training at large global batch.
+        y = self.norm(scale_init=nn.initializers.zeros_init(), name="bn3")(y)
+
+        if residual.shape != y.shape:
+            residual = self.conv(
+                4 * self.filters, (1, 1), self.strides, name="conv_proj"
+            )(residual)
+            residual = self.norm(name="bn_proj")(residual)
+        return self.act(y + residual)
+
+
+class BasicBlock(nn.Module):
+    """3x3 → 3x3 basic block (ResNet-18/34)."""
+
+    filters: int
+    strides: tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides, name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), name="conv2")(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init(), name="bn2")(y)
+
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters, (1, 1), self.strides, name="conv_proj"
+            )(residual)
+            residual = self.norm(name="bn_proj")(residual)
+        return self.act(y + residual)
+
+
+class ResNet(nn.Module):
+    """ResNet v1.5 family over NHWC inputs."""
+
+    stage_sizes: Sequence[int]
+    block_cls: type[nn.Module] = BottleneckBlock
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: jnp.dtype = jnp.float32
+    axis_name: str | None = None  # cross-replica BatchNorm under shard_map
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = True) -> jnp.ndarray:
+        conv = partial(nn.Conv, use_bias=False, padding="SAME", dtype=self.dtype)
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+            axis_name=self.axis_name if train else None,
+        )
+
+        x = x.astype(self.dtype)
+        x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(
+                    filters=self.num_filters * 2**i,
+                    strides=strides,
+                    conv=conv,
+                    norm=norm,
+                    act=nn.relu,
+                    name=f"stage{i}_block{j}",
+                )(x)
+
+        x = jnp.mean(x, axis=(1, 2))
+        # Head in f32 for numerically stable softmax/cross-entropy.
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x
+
+
+ResNet18 = partial(ResNet, stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock)
+ResNet34 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=BasicBlock)
+ResNet50 = partial(ResNet, stage_sizes=(3, 4, 6, 3))
+ResNet101 = partial(ResNet, stage_sizes=(3, 4, 23, 3))

@@ -1,0 +1,121 @@
+"""Transformer encoder (BASELINE config 5 — the wrapped-model adapter path).
+
+The reference's fifth benchmark config drives a Flux.Chain Transformer
+encoder through the ``FluxMPIFluxModel`` adapter (BASELINE.md config 5;
+reference ext/FluxMPIFluxExt.jl). Here the encoder is a flax module (its
+state is natively a pytree, so ``synchronize`` needs no adapter — the
+adapter path is exercised separately by wrapping it in
+:class:`fluxmpi_tpu.FluxModelWrapper`-style containers in tests).
+
+TPU-first choices: bf16-friendly dtype threading, pre-LayerNorm blocks
+(stable without warmup at large batch), attention via
+``nn.MultiHeadDotProductAttention`` (lowers to MXU-tiled batched matmuls),
+static shapes throughout. For sequence lengths beyond one chip's HBM, swap
+the attention callable for :func:`fluxmpi_tpu.parallel.ring.ring_attention`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["TransformerEncoder", "TransformerLM"]
+
+
+class EncoderBlock(nn.Module):
+    d_model: int
+    num_heads: int
+    d_ff: int
+    dropout: float
+    dtype: jnp.dtype
+    attention_fn: Callable | None = None
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = True, mask=None):
+        attn_kwargs = {}
+        if self.attention_fn is not None:
+            attn_kwargs["attention_fn"] = self.attention_fn
+        h = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
+        h = nn.MultiHeadDotProductAttention(
+            num_heads=self.num_heads,
+            dtype=self.dtype,
+            dropout_rate=self.dropout,
+            deterministic=not train,
+            name="attn",
+            **attn_kwargs,
+        )(h, h, mask=mask)
+        x = x + h
+        h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        h = nn.Dense(self.d_ff, dtype=self.dtype, name="ff1")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.d_model, dtype=self.dtype, name="ff2")(h)
+        return x + h
+
+
+class TransformerEncoder(nn.Module):
+    """Pre-LN encoder stack over already-embedded inputs
+    ``(batch, seq, d_model)``."""
+
+    num_layers: int = 4
+    d_model: int = 128
+    num_heads: int = 4
+    d_ff: int = 512
+    dropout: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+    attention_fn: Callable | None = None
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = True, mask=None):
+        x = x.astype(self.dtype)
+        for i in range(self.num_layers):
+            x = EncoderBlock(
+                d_model=self.d_model,
+                num_heads=self.num_heads,
+                d_ff=self.d_ff,
+                dropout=self.dropout,
+                dtype=self.dtype,
+                attention_fn=self.attention_fn,
+                name=f"block_{i}",
+            )(x, train=train, mask=mask)
+        return nn.LayerNorm(dtype=jnp.float32, name="ln_out")(x)
+
+
+class TransformerLM(nn.Module):
+    """Token-level wrapper: embedding + learned positions + encoder + LM
+    head (weight-tied)."""
+
+    vocab_size: int = 1024
+    max_len: int = 512
+    num_layers: int = 4
+    d_model: int = 128
+    num_heads: int = 4
+    d_ff: int = 512
+    dropout: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+    attention_fn: Callable | None = None
+
+    @nn.compact
+    def __call__(self, tokens, *, train: bool = True):
+        embed = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype, name="embed")
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (self.max_len, self.d_model),
+        )
+        seq = tokens.shape[-1]
+        x = embed(tokens) + pos[:seq][None, :, :].astype(self.dtype)
+        # causal mask
+        mask = nn.make_causal_mask(tokens)
+        x = TransformerEncoder(
+            num_layers=self.num_layers,
+            d_model=self.d_model,
+            num_heads=self.num_heads,
+            d_ff=self.d_ff,
+            dropout=self.dropout,
+            dtype=self.dtype,
+            attention_fn=self.attention_fn,
+            name="encoder",
+        )(x, train=train, mask=mask)
+        return embed.attend(x.astype(jnp.float32))

@@ -1,0 +1,189 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+Long-context scaling: the sequence dimension is sharded over a mesh axis
+(``sp``), each device holds one block of Q/K/V, and K/V blocks rotate around
+the ring via ``lax.ppermute`` (ICI neighbor hops) while each device
+accumulates its queries' attention with a numerically-stable online softmax
+(blockwise/flash accumulation). Peak memory is O(seq/n_devices) per device
+and the K/V transfer overlaps with the block computation, which is exactly
+the layout the TPU torus wants.
+
+The reference framework never touches the sequence dimension (SURVEY.md §5
+— DP-only); this module is the capability extension that makes long-context
+training first-class on TPU, designed so the ``sp`` axis composes with the
+``dp`` axis in one mesh (e.g. ``{"dp": 4, "sp": 2}``).
+
+Shapes: ``q, k, v`` are ``(batch, seq_local, heads, head_dim)`` inside a
+``shard_map`` whose in_specs shard the global sequence over ``axis_name``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import config
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+__all__ = ["ring_attention", "make_ring_attention", "ring_attention_fn"]
+
+_NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, o, m, l, mask):
+    """One blockwise online-softmax update.
+
+    q: [b, sq, h, d]; k/v: [b, sk, h, d]; o: [b, sq, h, d];
+    m/l: [b, sq, h]; mask: [sq, sk] boolean (True = attend) or None.
+    """
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    # scores: [b, h, sq, sk] — contraction on head_dim, batched on (b, h)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if mask is not None:
+        scores = jnp.where(mask[None, None, :, :], scores, _NEG_INF)
+    m_block = jnp.max(scores, axis=-1)  # [b, h, sq]
+    m_block = jnp.moveaxis(m_block, 1, -1)  # [b, sq, h]
+    m_new = jnp.maximum(m, m_block)
+    # renormalize previous accumulators
+    alpha = jnp.exp(m - m_new)  # [b, sq, h]
+    p = jnp.exp(scores - jnp.moveaxis(m_new, -1, 1)[:, :, :, None])  # [b,h,sq,sk]
+    if mask is not None:
+        p = jnp.where(mask[None, None, :, :], p, 0.0)
+    l_new = l * alpha + jnp.moveaxis(jnp.sum(p, axis=-1), 1, -1)
+    o_new = o * alpha[..., None] + jnp.moveaxis(
+        jnp.einsum("bhqk,bkhd->bhqd", p, v), 1, 2
+    )
+    return o_new, m_new, l_new
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str | None = None,
+    causal: bool = False,
+) -> jnp.ndarray:
+    """Blockwise ring attention; call inside ``shard_map`` with the sequence
+    dimension of q/k/v sharded over ``axis_name``.
+
+    Each of the ``n`` ring steps attends local queries to the K/V block
+    currently resident, then rotates K/V to the next ring neighbor. With
+    ``causal=True``, blocks strictly in the future are skipped via a zero
+    mask (compiled as a select — no dynamic control flow).
+    """
+    name = axis_name or config.SP_AXIS_NAME
+    n = jax.lax.axis_size(name)
+    idx = jax.lax.axis_index(name)
+    b, sq, h, d = q.shape
+
+    o = jnp.zeros_like(q, dtype=jnp.float32)
+    m = jnp.full((b, sq, h), _NEG_INF, dtype=jnp.float32)
+    l = jnp.zeros((b, sq, h), dtype=jnp.float32)
+
+    qf = q.astype(jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(s, carry):
+        o, m, l, k_blk, v_blk = carry
+        # After s rotations, the resident block originated on ring position
+        # (idx - s) mod n.
+        src = (idx - s) % n
+        kf = k_blk.astype(jnp.float32)
+        vf = v_blk.astype(jnp.float32)
+        if causal:
+            q_pos = idx * sq + jnp.arange(sq)
+            k_pos = src * k_blk.shape[1] + jnp.arange(k_blk.shape[1])
+            mask = q_pos[:, None] >= k_pos[None, :]
+        else:
+            mask = None
+        o2, m2, l2 = _block_attend(qf, kf, vf, o, m, l, mask)
+        k_next = jax.lax.ppermute(k_blk, name, perm)
+        v_next = jax.lax.ppermute(v_blk, name, perm)
+        return o2, m2, l2, k_next, v_next
+
+    o, m, l, _, _ = jax.lax.fori_loop(0, n, body, (o, m, l, k, v))
+    # Guard fully-masked rows (l == 0) against 0/0.
+    l = jnp.where(l == 0.0, 1.0, l)
+    return (o / l[..., None]).astype(q.dtype)
+
+
+def ring_attention_fn(axis_name: str | None = None, causal: bool = False):
+    """An ``attention_fn`` drop-in for ``nn.MultiHeadDotProductAttention``.
+
+    Use on a :class:`fluxmpi_tpu.models.TransformerEncoder` applied inside a
+    ``shard_map`` whose in_specs shard the sequence over ``axis_name`` —
+    every other encoder op (LayerNorm, MLP, residuals) is pointwise over the
+    sequence, so only attention needs the ring. Explicit masks are not
+    supported (use ``causal=True`` for causal masking; the mask is derived
+    from global ring positions).
+
+    Initialize parameters with a dense twin of the module (same config
+    minus ``attention_fn`` — the parameter tree is identical) or inside the
+    ``shard_map``: ``module.init`` outside it has no bound ``sp`` axis and
+    raises ``NameError: unbound axis name``.
+    """
+
+    def fn(query, key, value, bias=None, mask=None, **kwargs):
+        if bias is not None or mask is not None:
+            raise ValueError(
+                "ring_attention_fn derives masking from ring position; "
+                "pass causal=True instead of an explicit mask/bias"
+            )
+        return ring_attention(
+            query, key, value, axis_name=axis_name, causal=causal
+        )
+
+    return fn
+
+
+def make_ring_attention(
+    mesh: Mesh | None = None,
+    *,
+    axis_name: str | None = None,
+    causal: bool = False,
+    batch_axis_name: str | None = None,
+):
+    """Wrap :func:`ring_attention` for eager use on mesh-sharded arrays.
+
+    Returns ``fn(q, k, v) -> out`` where the inputs' sequence dimension
+    (axis 1) is laid out over ``axis_name`` (and optionally batch over
+    ``batch_axis_name``). Compiled once per shape.
+    """
+    from ..runtime import global_mesh
+
+    mesh = mesh or global_mesh()
+    sp = axis_name or config.SP_AXIS_NAME
+    dp = batch_axis_name
+    spec = P(dp, sp)
+
+    def body(q, k, v):
+        return ring_attention(q, k, v, axis_name=sp, causal=causal)
+
+    mapped = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    jitted = jax.jit(mapped)
+
+    def fn(q, k, v):
+        size = mesh.shape[sp]
+        for name_, t in (("q", q), ("k", k), ("v", v)):
+            if t.shape[1] % size != 0:
+                raise ValueError(
+                    f"{name_} sequence length {t.shape[1]} must divide the "
+                    f"'{sp}' mesh axis size {size} (pad the sequence)"
+                )
+        sharding = NamedSharding(mesh, spec)
+        q, k, v = (jax.device_put(t, sharding) for t in (q, k, v))
+        return jitted(q, k, v)
+
+    return fn

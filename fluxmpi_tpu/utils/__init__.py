@@ -1,0 +1,4 @@
+"""Auxiliary subsystems: checkpointing, profiling, pytree helpers."""
+
+from .checkpoint import restore_checkpoint, save_checkpoint  # noqa: F401
+from .profiling import profile_trace, step_timer  # noqa: F401

@@ -1,0 +1,86 @@
+"""Checkpoint / resume.
+
+The reference has no checkpoint subsystem; its enabling primitive is
+``synchronize!`` — load state on the root rank, broadcast to all
+(SURVEY.md §5; reference src/synchronize.jl). Here that pattern becomes a
+first-class pair: :func:`save_checkpoint` writes the (replicated) train
+state from the lead process via orbax; :func:`restore_checkpoint` reads it
+and re-synchronizes/replicates it over the mesh — the exact
+load-on-root-then-broadcast flow, one call.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+from ..sync import synchronize
+
+__all__ = ["save_checkpoint", "restore_checkpoint"]
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_checkpoint(path: str, state: Any, *, force: bool = True) -> None:
+    """Write ``state`` (any pytree, e.g. a TrainState) to ``path``.
+
+    Only the lead process writes (replicated DP state is identical
+    everywhere); all processes must call (collective barrier at the end) so
+    the flow is SPMD-safe.
+    """
+    path = os.path.abspath(path)
+    if jax.process_index() == 0:
+        # Only the writer pays the device→host transfer; replicated DP
+        # state is identical on every process.
+        host_state = jax.tree_util.tree_map(
+            lambda x: np.asarray(jax.device_get(x))
+            if isinstance(x, (jax.Array, np.ndarray))
+            else x,
+            state,
+        )
+        _checkpointer().save(path, host_state, force=force)
+    if jax.process_count() > 1:  # pragma: no cover - multihost only
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"ckpt_save:{path}")
+
+
+def restore_checkpoint(path: str, like: Any, *, root_rank: int = 0) -> Any:
+    """Read the checkpoint at ``path`` and return it synchronized from
+    ``root_rank`` and laid out like ``like`` (replicated over the mesh).
+
+    The load-on-root-then-broadcast pattern (reference guidance,
+    SURVEY.md §5 "Checkpoint/resume"): every process calls this; the root's
+    bytes win and land replicated on every device.
+    """
+    path = os.path.abspath(path)
+    restored = _checkpointer().restore(path, item=jax.tree_util.tree_map(
+        lambda x: np.asarray(jax.device_get(x))
+        if isinstance(x, (jax.Array, np.ndarray))
+        else x,
+        like,
+    ))
+    synced = synchronize(restored, root_rank=root_rank)
+
+    # Match leaf types/placement of `like` (replicated jax arrays), refusing
+    # silent shape mismatches — restoring a (2,) kernel into a (3,) slot
+    # must fail loudly, not produce a corrupted state.
+    def _place(r, l):
+        if isinstance(l, jax.Array):
+            r_arr = jax.numpy.asarray(r, dtype=l.dtype)
+            if r_arr.shape != l.shape:
+                raise ValueError(
+                    f"checkpoint leaf shape {r_arr.shape} does not match "
+                    f"expected {l.shape}"
+                )
+            return jax.device_put(r_arr, l.sharding)
+        return r
+
+    return jax.tree_util.tree_map(_place, synced, like)

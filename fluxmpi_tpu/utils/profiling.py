@@ -1,0 +1,76 @@
+"""Profiling / tracing helpers.
+
+The reference has no tracing subsystem (SURVEY.md §5: only wall-clock
+deltas in example scripts). On TPU the JAX profiler is nearly free to
+expose: :func:`profile_trace` captures an XPlane trace viewable in
+TensorBoard/Perfetto; :func:`step_timer` gives honest step timings around
+async dispatch (blocks on results — the ``MPI.Waitall!`` of timing).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Iterator
+
+import jax
+
+__all__ = ["profile_trace", "step_timer"]
+
+
+@contextlib.contextmanager
+def profile_trace(logdir: str, *, host_only: bool = False) -> Iterator[None]:
+    """Capture a profiler trace of the enclosed block into ``logdir``.
+
+    Only the lead process traces (device activity is mirrored across DP
+    replicas). View with TensorBoard's profile plugin or Perfetto.
+    """
+    if host_only or jax.process_index() == 0:
+        with jax.profiler.trace(logdir):
+            yield
+    else:  # pragma: no cover - multihost only
+        yield
+
+
+class _TimerHandle:
+    def __init__(self) -> None:
+        self._watched: list[Any] = []
+
+    def watch(self, tree: Any) -> Any:
+        """Register outputs to block on before the clock stops (returns the
+        tree for inline use: ``out = t.watch(step(...))``)."""
+        self._watched.append(tree)
+        return tree
+
+
+@contextlib.contextmanager
+def step_timer(result_holder: dict, key: str = "seconds") -> Iterator[_TimerHandle]:
+    """Time the enclosed block including async-dispatched device work.
+
+    Register the block's outputs with ``handle.watch(out)`` so the timer
+    blocks on them before stopping the clock (the ``MPI.Waitall!`` of
+    timing). With nothing watched, a sentinel computation is enqueued per
+    local device and blocked on — TPU executes programs in order per
+    device, so this drains prior dispatched work.
+    """
+    handle = _TimerHandle()
+    t0 = time.perf_counter()
+    yield handle
+    if handle._watched:
+        jax.block_until_ready(handle._watched)
+    else:
+        import jax.numpy as jnp
+
+        bump = jax.jit(lambda x: x + 1)
+        for d in jax.local_devices():
+            bump(jax.device_put(jnp.zeros(()), d)).block_until_ready()
+    result_holder[key] = time.perf_counter() - t0
+
+
+def block_on(tree: Any) -> Any:
+    """Block until every array in ``tree`` is ready (the timing analogue of
+    ``MPI.Waitall!``, reference src/optimizer.jl:59). Returns the tree."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "block_until_ready"):
+            leaf.block_until_ready()
+    return tree

@@ -1,0 +1,275 @@
+"""Model zoo tests: every BASELINE config builds, runs forward, and takes a
+DP train step on the 8-device mesh; DEQ gradients match the unrolled oracle;
+BatchNorm state flows through the train step and synchronize."""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# forward shapes
+# ---------------------------------------------------------------------------
+
+
+def test_cnn_forward(world):
+    from fluxmpi_tpu.models import CNN
+
+    model = CNN(num_classes=10)
+    x = jnp.ones((4, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (4, 10)
+    assert "batch_stats" in variables
+
+
+def test_resnet18_forward(world):
+    from fluxmpi_tpu.models import ResNet18
+
+    model = ResNet18(num_classes=10)
+    x = jnp.ones((2, 64, 64, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (2, 10)
+
+
+def test_resnet50_builds(world):
+    from fluxmpi_tpu.models import ResNet50
+
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    x = jnp.ones((2, 64, 64, 3), jnp.bfloat16)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (2, 1000)
+    assert out.dtype == jnp.float32  # f32 head
+    n_params = sum(p.size for p in jax.tree_util.tree_leaves(variables["params"]))
+    assert 20e6 < n_params < 30e6  # ~25.5M — the ResNet-50 signature
+
+
+def test_deq_forward(world):
+    from fluxmpi_tpu.models import DEQ
+
+    model = DEQ(hidden=32, out=1)
+    x = jnp.ones((4, 3))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    out = model.apply(variables, x)
+    assert out.shape == (4, 1)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_transformer_forward(world):
+    from fluxmpi_tpu.models import TransformerEncoder, TransformerLM
+
+    enc = TransformerEncoder(num_layers=2, d_model=32, num_heads=4, d_ff=64)
+    x = jnp.ones((2, 16, 32))
+    variables = enc.init(jax.random.PRNGKey(0), x, train=False)
+    out = enc.apply(variables, x, train=False)
+    assert out.shape == (2, 16, 32)
+
+    lm = TransformerLM(vocab_size=64, max_len=32, num_layers=2, d_model=32,
+                       num_heads=4, d_ff=64)
+    toks = jnp.zeros((2, 16), jnp.int32)
+    variables = lm.init(jax.random.PRNGKey(0), toks, train=False)
+    logits = lm.apply(variables, toks, train=False)
+    assert logits.shape == (2, 16, 64)
+
+
+# ---------------------------------------------------------------------------
+# DEQ implicit gradient oracle
+# ---------------------------------------------------------------------------
+
+
+def test_deq_implicit_gradient_matches_unrolled(world):
+    from fluxmpi_tpu.models.deq import fixed_point_solve
+
+    hidden, batch = 8, 4
+    key = jax.random.PRNGKey(0)
+    k1, k2, k3 = jax.random.split(key, 3)
+    W = jax.random.normal(k1, (hidden, hidden)) * 0.1
+    U = jax.random.normal(k2, (3, hidden)) * 0.5
+    b = jnp.zeros((hidden,))
+    x = jax.random.normal(k3, (batch, 3))
+
+    def cell(params, xx, z):
+        W_, U_, b_ = params
+        return jnp.tanh(z @ W_ + xx @ U_ + b_)
+
+    def loss_implicit(params):
+        z0 = jnp.zeros((batch, hidden))
+        z = fixed_point_solve(cell, params, x, z0, 1e-8, 200, 1.0)
+        return jnp.sum(z**2)
+
+    def loss_unrolled(params):
+        z = jnp.zeros((batch, hidden))
+        for _ in range(200):  # plain unrolled AD as oracle
+            z = cell(params, x, z)
+        return jnp.sum(z**2)
+
+    g_imp = jax.grad(loss_implicit)((W, U, b))
+    g_unr = jax.grad(loss_unrolled)((W, U, b))
+    for a, b_ in zip(g_imp, g_unr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-4)
+
+
+def test_deq_trains_under_dp(world):
+    # collectives + custom VJP under jit over the mesh (SURVEY.md §7 hard part)
+    import fluxmpi_tpu as fm
+    from fluxmpi_tpu.models import DEQ
+    from fluxmpi_tpu.parallel import TrainState, make_train_step
+    from fluxmpi_tpu.parallel.train import replicate, shard_batch
+
+    model = DEQ(hidden=16, out=1)
+    x = np.random.default_rng(0).normal(size=(32, 3)).astype(np.float32)
+    y = (x.sum(axis=1, keepdims=True) ** 2).astype(np.float32)
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(x[:2]))
+    optimizer = optax.adam(1e-2)
+
+    def loss_fn(p, ms, batch):
+        bx, by = batch
+        return jnp.mean((model.apply(p, bx) - by) ** 2), ms
+
+    # shard_map style: the custom VJP runs per-device with explicit psum after
+    step = make_train_step(
+        loss_fn, optimizer, style="shard_map", grad_reduce="mean", donate=False
+    )
+    state = replicate(TrainState.create(params, optimizer))
+    batch = shard_batch((jnp.asarray(x), jnp.asarray(y)))
+    losses = []
+    for _ in range(30):
+        state, loss = step(state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+# ---------------------------------------------------------------------------
+# BatchNorm model state under DP
+# ---------------------------------------------------------------------------
+
+
+def _cnn_setup():
+    from fluxmpi_tpu.models import CNN
+
+    model = CNN(num_classes=10, channels=(8, 16))
+    x = np.random.default_rng(0).normal(size=(16, 16, 16, 3)).astype(np.float32)
+    y = np.random.default_rng(1).integers(0, 10, size=(16,)).astype(np.int32)
+    variables = model.init(jax.random.PRNGKey(0), jnp.asarray(x[:2]), train=False)
+    return model, variables, x, y
+
+
+def test_cnn_train_step_updates_batch_stats(world):
+    import fluxmpi_tpu as fm
+    from fluxmpi_tpu.parallel import TrainState, make_train_step
+    from fluxmpi_tpu.parallel.train import replicate, shard_batch
+
+    model, variables, x, y = _cnn_setup()
+    optimizer = optax.sgd(0.1)
+
+    def loss_fn(params, batch_stats, batch):
+        bx, by = batch
+        logits, updates = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            bx,
+            train=True,
+            mutable=["batch_stats"],
+        )
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, by).mean()
+        return loss, updates["batch_stats"]
+
+    step = make_train_step(loss_fn, optimizer, style="auto", donate=False)
+    state = replicate(
+        TrainState.create(variables["params"], optimizer, variables["batch_stats"])
+    )
+    batch = shard_batch((jnp.asarray(x), jnp.asarray(y)))
+    before = np.asarray(
+        jax.tree_util.tree_leaves(state.model_state)[0]
+    ).copy()
+    state, loss = step(state, batch)
+    after = np.asarray(jax.tree_util.tree_leaves(state.model_state)[0])
+    assert np.isfinite(float(loss))
+    assert not np.array_equal(before, after)  # running stats moved
+
+
+def test_cnn_sync_bn_matches_global_stats(world, nworkers):
+    # Cross-replica BN in shard_map must equal global-batch BN in auto style
+    import fluxmpi_tpu as fm
+    from fluxmpi_tpu.models import CNN
+    from fluxmpi_tpu.parallel import make_train_step, TrainState
+    from fluxmpi_tpu.parallel.train import replicate, shard_batch
+
+    x = np.random.default_rng(0).normal(size=(16, 8, 8, 3)).astype(np.float32)
+    y = np.zeros((16,), np.int32)
+    optimizer = optax.sgd(0.1)
+
+    results = {}
+    for style, axis_name in (("auto", None), ("shard_map", "dp")):
+        model = CNN(num_classes=4, channels=(8,), axis_name=axis_name)
+        variables = model.init(
+            jax.random.PRNGKey(0), jnp.asarray(x[:2]), train=False
+        )
+
+        def loss_fn(params, batch_stats, batch, model=model):
+            bx, by = batch
+            logits, updates = model.apply(
+                {"params": params, "batch_stats": batch_stats},
+                bx,
+                train=True,
+                mutable=["batch_stats"],
+            )
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, by
+            ).mean()
+            return loss, updates["batch_stats"]
+
+        step = make_train_step(
+            loss_fn, optimizer, style=style, grad_reduce="mean",
+            state_reduce="mean", donate=False
+        )
+        state = replicate(
+            TrainState.create(
+                variables["params"], optimizer, variables["batch_stats"]
+            )
+        )
+        batch = shard_batch((jnp.asarray(x), jnp.asarray(y)))
+        state, _ = step(state, batch)
+        results[style] = jax.tree_util.tree_map(np.asarray, state.model_state)
+
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+        results["auto"],
+        results["shard_map"],
+    )
+
+
+def test_transformer_trains(world):
+    import fluxmpi_tpu as fm
+    from fluxmpi_tpu.models import TransformerLM
+    from fluxmpi_tpu.parallel import TrainState, make_train_step
+    from fluxmpi_tpu.parallel.train import replicate, shard_batch
+
+    model = TransformerLM(vocab_size=32, max_len=16, num_layers=2, d_model=32,
+                          num_heads=2, d_ff=64)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 32, size=(16, 16)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(toks[:2]), train=False)
+    optimizer = optax.adam(1e-3)
+
+    def loss_fn(p, ms, batch):
+        b = batch
+        logits = model.apply(p, b, train=True)
+        targets = jnp.roll(b, -1, axis=-1)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], targets[:, :-1]
+        ).mean()
+        return loss, ms
+
+    step = make_train_step(loss_fn, optimizer, style="auto", donate=False)
+    state = replicate(TrainState.create(params, optimizer))
+    batch = shard_batch(jnp.asarray(toks))
+    losses = []
+    for _ in range(10):
+        state, loss = step(state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]

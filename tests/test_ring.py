@@ -1,0 +1,166 @@
+"""Ring attention vs dense attention oracle on the 8-device mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+def _dense_attention(q, k, v, causal=False):
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        scores = jnp.where(mask[None, None], scores, -1e30)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _qkv(batch=2, seq=32, heads=4, dim=16, seed=0):
+    rng = np.random.default_rng(seed)
+    shape = (batch, seq, heads, dim)
+    return tuple(
+        jnp.asarray(rng.normal(size=shape).astype(np.float32)) for _ in range(3)
+    )
+
+
+@pytest.fixture()
+def sp_mesh(world):
+    """A mesh with an sp axis for sequence parallelism."""
+    import jax
+    from jax.sharding import Mesh
+
+    import numpy as np
+
+    return Mesh(np.asarray(jax.devices()[:8]).reshape(8), ("sp",))
+
+
+def test_ring_matches_dense(sp_mesh):
+    from fluxmpi_tpu.parallel.ring import make_ring_attention
+
+    q, k, v = _qkv()
+    fn = make_ring_attention(sp_mesh, axis_name="sp")
+    out = fn(q, k, v)
+    expected = _dense_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5)
+
+
+def test_ring_matches_dense_causal(sp_mesh):
+    from fluxmpi_tpu.parallel.ring import make_ring_attention
+
+    q, k, v = _qkv(seed=1)
+    fn = make_ring_attention(sp_mesh, axis_name="sp", causal=True)
+    out = fn(q, k, v)
+    expected = _dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5)
+
+
+def test_ring_bf16(sp_mesh):
+    from fluxmpi_tpu.parallel.ring import make_ring_attention
+
+    q, k, v = (t.astype(jnp.bfloat16) for t in _qkv(seed=2))
+    fn = make_ring_attention(sp_mesh, axis_name="sp")
+    out = fn(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    expected = _dense_attention(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32)
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, dtype=np.float32), np.asarray(expected), atol=0.05
+    )
+
+
+def test_ring_composes_with_dp(world):
+    # 2-D mesh: batch over dp, sequence over sp — the composition the
+    # long-context design requires.
+    from jax.sharding import Mesh
+
+    from fluxmpi_tpu.parallel.ring import make_ring_attention
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4), ("dp", "sp"))
+    q, k, v = _qkv(batch=4, seq=16, seed=3)
+    fn = make_ring_attention(mesh, axis_name="sp", batch_axis_name="dp")
+    out = fn(q, k, v)
+    expected = _dense_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5)
+
+
+def test_ring_grad_finite(sp_mesh):
+    # differentiable end-to-end (ppermute has a transpose rule)
+    from jax.sharding import PartitionSpec as P
+
+    from fluxmpi_tpu.parallel.ring import ring_attention
+
+    q, k, v = _qkv(seq=16, seed=4)
+
+    def loss(q, k, v):
+        out = ring_attention(q, k, v, axis_name="sp")
+        return jnp.sum(out**2)
+
+    try:
+        sm = jax.shard_map
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map as sm
+
+    def per_device(q, k, v):
+        l = loss(q, k, v)
+        return jax.lax.psum(l, "sp")
+
+    mapped = sm(
+        per_device,
+        mesh=sp_mesh,
+        in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
+        out_specs=P(),
+        check_vma=False,
+    )
+    g = jax.jit(jax.grad(lambda q, k, v: mapped(q, k, v)))(q, k, v)
+    assert np.all(np.isfinite(np.asarray(g)))
+
+
+def test_transformer_with_ring_attention(sp_mesh):
+    # End-to-end sequence parallelism: a TransformerEncoder whose attention
+    # runs on the ring matches the same encoder with dense attention.
+    from jax.sharding import PartitionSpec as P
+
+    from fluxmpi_tpu.models import TransformerEncoder
+    from fluxmpi_tpu.parallel.ring import ring_attention_fn
+
+    try:
+        sm = jax.shard_map
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map as sm
+
+    d_model, seq = 32, 32
+    x = jnp.asarray(
+        np.random.default_rng(5).normal(size=(2, seq, d_model)).astype(np.float32)
+    )
+    dense_model = TransformerEncoder(
+        num_layers=2, d_model=d_model, num_heads=4, d_ff=64
+    )
+    variables = dense_model.init(jax.random.PRNGKey(0), x, train=False)
+    expected = dense_model.apply(variables, x, train=False)
+
+    ring_model = TransformerEncoder(
+        num_layers=2,
+        d_model=d_model,
+        num_heads=4,
+        d_ff=64,
+        attention_fn=ring_attention_fn(axis_name="sp"),
+    )
+
+    def apply_local(v, xx):
+        return ring_model.apply(v, xx, train=False)
+
+    mapped = sm(
+        apply_local,
+        mesh=sp_mesh,
+        in_specs=(P(), P(None, "sp")),
+        out_specs=P(None, "sp"),
+        check_vma=False,
+    )
+    out = jax.jit(mapped)(variables, x)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expected), atol=3e-5
+    )
