@@ -273,20 +273,30 @@ class DistributedDataLoader:
         if backing is not None:
             # Native fast path: one C++ prefetcher per array leaf assembles
             # the next batches on background threads while the device runs
-            # the current step.
-            from .io import NativePrefetcher
+            # the current step. The prefetcher only serves whole batches;
+            # the ragged tail under drop_last=False is gathered directly so
+            # the epoch yields exactly len(self) batches either way.
+            from .io import NativePrefetcher, gather_rows
 
             arrays, offset = backing
             lbs = self.local_batch_size
-            epoch_order = order[: nbatches * lbs] + offset
+            full = self._common_len // lbs
             leaves, treedef = jax.tree_util.tree_flatten(arrays)
-            prefetchers = [
-                iter(NativePrefetcher(leaf, epoch_order, lbs))
-                for leaf in leaves
-            ]
-            for leaf_batches in zip(*prefetchers):
+            if full:
+                epoch_order = order[: full * lbs] + offset
+                prefetchers = [
+                    iter(NativePrefetcher(leaf, epoch_order, lbs))
+                    for leaf in leaves
+                ]
+                for leaf_batches in zip(*prefetchers):
+                    batch = jax.tree_util.tree_unflatten(
+                        treedef, list(leaf_batches)
+                    )
+                    yield _globalize(batch)
+            if nbatches > full:
+                tail = order[full * lbs : self._common_len] + offset
                 batch = jax.tree_util.tree_unflatten(
-                    treedef, list(leaf_batches)
+                    treedef, [gather_rows(leaf, tail) for leaf in leaves]
                 )
                 yield _globalize(batch)
             return

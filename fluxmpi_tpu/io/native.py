@@ -158,18 +158,19 @@ def gather_rows(
         )
     if lib is None:
         return array[idx]
+    flat_idx = idx.reshape(-1)  # numpy-parity for multi-dim index arrays
     a2 = _as_2d_rows(array)
-    out = np.empty((len(idx), a2.shape[1]), dtype=array.dtype)
+    out = np.empty((flat_idx.size, a2.shape[1]), dtype=array.dtype)
     row_bytes = a2.shape[1] * array.dtype.itemsize
     lib.fm_gather(
         a2.ctypes.data_as(ctypes.c_void_p),
         row_bytes,
-        idx.ctypes.data_as(ctypes.c_void_p),
-        len(idx),
+        flat_idx.ctypes.data_as(ctypes.c_void_p),
+        flat_idx.size,
         out.ctypes.data_as(ctypes.c_void_p),
         threads or min(8, os.cpu_count() or 1),
     )
-    return out.reshape((len(idx),) + array.shape[1:])
+    return out.reshape(idx.shape + array.shape[1:])
 
 
 class NativePrefetcher:

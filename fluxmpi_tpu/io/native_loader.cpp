@@ -22,6 +22,7 @@
 #include <cstdint>
 #include <cstring>
 #include <deque>
+#include <memory>
 #include <mutex>
 #include <thread>
 #include <vector>
@@ -58,6 +59,87 @@ struct Batch {
   int64_t batch_index;
 };
 
+// Persistent worker pool: the per-batch gather cost must be the memcpy, not
+// thread create/join churn — with small batches transient threads would
+// dominate.
+class GatherPool {
+ public:
+  explicit GatherPool(int n_workers) : n_(n_workers > 1 ? n_workers : 0) {
+    for (int i = 0; i < n_; ++i) {
+      workers_.emplace_back([this, i] { worker_loop(i); });
+    }
+  }
+
+  ~GatherPool() {
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      shutdown_ = true;
+      ++generation_;
+    }
+    cv_work_.notify_all();
+    for (auto& w : workers_) w.join();
+  }
+
+  void gather(const uint8_t* src, uint64_t row_bytes, const int64_t* idx,
+              uint64_t n, uint8_t* dst) {
+    if (n_ == 0 || n < 64) {
+      gather_range(src, row_bytes, idx, 0, n, dst);
+      return;
+    }
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      src_ = src;
+      row_bytes_ = row_bytes;
+      idx_ = idx;
+      n_rows_ = n;
+      dst_ = dst;
+      remaining_ = n_;
+      ++generation_;
+    }
+    cv_work_.notify_all();
+    std::unique_lock<std::mutex> lock(mu_);
+    cv_done_.wait(lock, [&] { return remaining_ == 0; });
+  }
+
+ private:
+  void worker_loop(int me) {
+    uint64_t seen = 0;
+    while (true) {
+      std::unique_lock<std::mutex> lock(mu_);
+      cv_work_.wait(lock, [&] { return generation_ != seen; });
+      seen = generation_;
+      if (shutdown_) return;
+      const uint8_t* src = src_;
+      uint64_t row_bytes = row_bytes_;
+      const int64_t* idx = idx_;
+      uint64_t n = n_rows_;
+      uint8_t* dst = dst_;
+      lock.unlock();
+
+      uint64_t chunk = (n + n_ - 1) / n_;
+      uint64_t begin = static_cast<uint64_t>(me) * chunk;
+      uint64_t end = begin + chunk < n ? begin + chunk : n;
+      if (begin < n) gather_range(src, row_bytes, idx, begin, end, dst);
+
+      lock.lock();
+      if (--remaining_ == 0) cv_done_.notify_all();
+    }
+  }
+
+  const int n_;
+  std::vector<std::thread> workers_;
+  std::mutex mu_;
+  std::condition_variable cv_work_, cv_done_;
+  uint64_t generation_ = 0;
+  bool shutdown_ = false;
+  const uint8_t* src_ = nullptr;
+  uint64_t row_bytes_ = 0;
+  const int64_t* idx_ = nullptr;
+  uint64_t n_rows_ = 0;
+  uint8_t* dst_ = nullptr;
+  int remaining_ = 0;
+};
+
 struct Prefetcher {
   const uint8_t* src;
   uint64_t row_bytes;
@@ -65,6 +147,7 @@ struct Prefetcher {
   uint64_t batch_rows;
   uint64_t n_batches;
   int gather_threads;
+  std::unique_ptr<GatherPool> pool;
 
   std::deque<Batch> queue;
   uint64_t next_batch = 0;      // next batch index the producer will build
@@ -90,8 +173,8 @@ struct Prefetcher {
       Batch batch;
       batch.batch_index = static_cast<int64_t>(b);
       batch.data.resize(batch_rows * row_bytes);
-      gather_mt(src, row_bytes, order.data() + b * batch_rows, batch_rows,
-                batch.data.data(), gather_threads);
+      pool->gather(src, row_bytes, order.data() + b * batch_rows, batch_rows,
+                   batch.data.data());
       {
         std::lock_guard<std::mutex> lock(mu);
         queue.push_back(std::move(batch));
@@ -126,6 +209,7 @@ void* fm_prefetch_create(const uint8_t* src, uint64_t row_bytes,
   p->n_batches = n_rows / batch_rows;  // drop_last semantics
   p->capacity = queue_capacity ? queue_capacity : 2;
   p->gather_threads = gather_threads > 0 ? gather_threads : 1;
+  p->pool.reset(new GatherPool(p->gather_threads));
   p->producer = std::thread(&Prefetcher::run, p);
   return p;
 }

@@ -1,0 +1,167 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The single-chip hot op under :mod:`fluxmpi_tpu.parallel.ring`'s ring layer:
+ring attention moves K/V blocks *between* chips over ICI; this kernel makes
+the *on-chip* block computation memory-optimal — Q/K/V tiles stream
+HBM→VMEM, scores never materialize in HBM, and the online-softmax
+accumulators live in VMEM scratch across the K-block grid dimension.
+
+Block sizes default to MXU/VPU-friendly shapes (128 lanes; f32 accumulation
+regardless of input dtype). On non-TPU backends the kernel runs in Pallas
+interpret mode, which is how the CPU test suite exercises it.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = -1e30
+
+
+def _flash_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    m_scratch,
+    l_scratch,
+    acc_scratch,
+    *,
+    sm_scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    num_k_blocks: int,
+):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scratch[...] = jnp.full_like(m_scratch, _NEG_INF)
+        l_scratch[...] = jnp.zeros_like(l_scratch)
+        acc_scratch[...] = jnp.zeros_like(acc_scratch)
+
+    q = q_ref[0].astype(jnp.float32)  # [block_q, d]
+    k = k_ref[0].astype(jnp.float32)  # [block_k, d]
+    v = v_ref[0].astype(jnp.float32)  # [block_k, d]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * sm_scale  # [block_q, block_k]
+
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+    m_prev = m_scratch[...]  # [block_q, 128] (value replicated over lanes)
+    l_prev = l_scratch[...]
+    m_cur = jnp.max(s, axis=1, keepdims=True)  # [block_q, 1]
+    m_cur = jnp.broadcast_to(m_cur, m_prev.shape)
+    m_new = jnp.maximum(m_prev, m_cur)
+
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[:, :1])  # [block_q, block_k]
+    if causal:
+        p = jnp.where(q_pos >= k_pos, p, 0.0)
+    l_new = l_prev * alpha + jnp.broadcast_to(
+        jnp.sum(p, axis=1, keepdims=True), l_prev.shape
+    )
+
+    acc_scratch[...] = acc_scratch[...] * alpha[:, :1] + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    m_scratch[...] = m_new
+    l_scratch[...] = l_new
+
+    @pl.when(kj == num_k_blocks - 1)
+    def _finish():
+        l_final = l_scratch[...][:, :1]
+        l_safe = jnp.where(l_final == 0.0, 1.0, l_final)
+        o_ref[0] = (acc_scratch[...] / l_safe).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Memory-optimal attention over ``(batch, seq, heads, head_dim)``.
+
+    Tiles stream through VMEM with online-softmax accumulation; the
+    ``[seq, seq]`` score matrix never exists in HBM. Sequence length must
+    divide the block sizes (pad upstream). f32 accumulation, output in the
+    input dtype.
+    """
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(
+            f"sequence lengths ({sq}, {sk}) must be divisible by block sizes "
+            f"({block_q}, {block_k})"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    sm_scale = 1.0 / (d**0.5)
+    num_k_blocks = sk // block_k
+
+    # Fold heads into batch; kernel works on [bh, seq, d].
+    qr = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    kernel = functools.partial(
+        _flash_kernel,
+        sm_scale=sm_scale,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+        num_k_blocks=num_k_blocks,
+    )
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // block_q, num_k_blocks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qr, kr, vr)
+
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

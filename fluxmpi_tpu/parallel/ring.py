@@ -179,8 +179,8 @@ def make_ring_attention(
         for name_, t in (("q", q), ("k", k), ("v", v)):
             if t.shape[1] % size != 0:
                 raise ValueError(
-                    f"{name_} sequence length {t.shape[1]} must divide the "
-                    f"'{sp}' mesh axis size {size} (pad the sequence)"
+                    f"{name_} sequence length {t.shape[1]} must be divisible "
+                    f"by the '{sp}' mesh axis size {size} (pad the sequence)"
                 )
         sharding = NamedSharding(mesh, spec)
         q, k, v = (jax.device_put(t, sharding) for t in (q, k, v))

@@ -61,12 +61,26 @@ def restore_checkpoint(path: str, like: Any, *, root_rank: int = 0) -> Any:
     bytes win and land replicated on every device.
     """
     path = os.path.abspath(path)
-    restored = _checkpointer().restore(path, item=jax.tree_util.tree_map(
-        lambda x: np.asarray(jax.device_get(x))
-        if isinstance(x, (jax.Array, np.ndarray))
-        else x,
-        like,
-    ))
+    # The restore template only needs structure/shape/dtype — avoid pulling
+    # the whole live state to host just to describe it.
+    try:
+        template = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+            if isinstance(x, jax.Array)
+            else x,
+            like,
+        )
+        restored = _checkpointer().restore(path, item=template)
+    except Exception:
+        restored = _checkpointer().restore(
+            path,
+            item=jax.tree_util.tree_map(
+                lambda x: np.asarray(jax.device_get(x))
+                if isinstance(x, (jax.Array, np.ndarray))
+                else x,
+                like,
+            ),
+        )
     synced = synchronize(restored, root_rank=root_rank)
 
     # Match leaf types/placement of `like` (replicated jax arrays), refusing

@@ -64,3 +64,30 @@ def test_prefetcher_large_stress():
         count += 1
     assert count == 32
     np.testing.assert_allclose(total, float(arr[order].sum()), rtol=1e-4)
+
+
+def test_gather_multidim_indices_numpy_parity():
+    from fluxmpi_tpu.io import gather_rows
+
+    rng = np.random.default_rng(3)
+    arr = rng.normal(size=(50, 6)).astype(np.float32)
+    idx = rng.integers(0, 50, size=(4, 2))
+    np.testing.assert_array_equal(gather_rows(arr, idx), arr[idx])
+
+
+def test_fast_path_ragged_tail(world):
+    # drop_last=False with an ArrayDataset must yield the ragged final
+    # batch, matching the generic path and len(loader).
+    import fluxmpi_tpu as fm
+
+    # 24 rows, global batch 16 on the 8-device mesh → one full batch of 16
+    # plus a ragged tail of 8 (divisible by the axis, so valid).
+    xs = np.arange(24 * 4, dtype=np.float32).reshape(24, 4)
+    ads = fm.ArrayDataset((xs,))
+    loader = fm.DistributedDataLoader(ads, 16, drop_last=False)
+    batches = list(loader)
+    assert len(batches) == len(loader) == 2
+    assert batches[0][0].shape[0] == 16
+    assert batches[1][0].shape[0] == 8
+    total = sum(float(np.asarray(b[0]).sum()) for b in batches)
+    np.testing.assert_allclose(total, xs.sum())

@@ -1,0 +1,78 @@
+"""Pallas flash attention vs dense oracle (interpret mode on CPU)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+def _dense(q, k, v, causal=False):
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _qkv(b=2, s=64, h=2, d=32, seed=0):
+    rng = np.random.default_rng(seed)
+    return tuple(
+        jnp.asarray(rng.normal(size=(b, s, h, d)).astype(np.float32))
+        for _ in range(3)
+    )
+
+
+def test_flash_matches_dense(world):
+    from fluxmpi_tpu.ops import flash_attention
+
+    q, k, v = _qkv()
+    out = flash_attention(q, k, v, block_q=32, block_k=32)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_dense(q, k, v)), atol=2e-5
+    )
+
+
+def test_flash_causal(world):
+    from fluxmpi_tpu.ops import flash_attention
+
+    q, k, v = _qkv(seed=1)
+    out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_dense(q, k, v, causal=True)), atol=2e-5
+    )
+
+
+def test_flash_single_block(world):
+    from fluxmpi_tpu.ops import flash_attention
+
+    q, k, v = _qkv(s=16, seed=2)
+    out = flash_attention(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_dense(q, k, v)), atol=2e-5
+    )
+
+
+def test_flash_bf16(world):
+    from fluxmpi_tpu.ops import flash_attention
+
+    q, k, v = (t.astype(jnp.bfloat16) for t in _qkv(seed=3))
+    out = flash_attention(q, k, v, block_q=32, block_k=32)
+    assert out.dtype == jnp.bfloat16
+    expected = _dense(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32)
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, dtype=np.float32), np.asarray(expected), atol=0.05
+    )
+
+
+def test_flash_bad_blocks_rejected(world):
+    from fluxmpi_tpu.ops import flash_attention
+
+    q, k, v = _qkv(s=48, seed=4)
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v, block_q=32, block_k=32)
