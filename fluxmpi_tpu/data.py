@@ -302,6 +302,11 @@ class DistributedDataLoader:
             return
 
         for b in range(nbatches):
-            idxs = order[b * self.local_batch_size : (b + 1) * self.local_batch_size]
+            # Cap at _common_len so every process yields the same local batch
+            # size even when shard lengths differ (the ragged tail under
+            # drop_last=False) — mismatched local sizes would break the
+            # cross-process global-array assembly.
+            stop = min((b + 1) * self.local_batch_size, self._common_len)
+            idxs = order[b * self.local_batch_size : stop]
             batch = _stack_samples([self.data[int(i)] for i in idxs])
             yield _globalize(batch)

@@ -48,42 +48,53 @@ def _flash_kernel(
         l_scratch[...] = jnp.zeros_like(l_scratch)
         acc_scratch[...] = jnp.zeros_like(acc_scratch)
 
-    q = q_ref[0].astype(jnp.float32)  # [block_q, d]
-    k = k_ref[0].astype(jnp.float32)  # [block_k, d]
-    v = v_ref[0].astype(jnp.float32)  # [block_k, d]
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # [block_q, d]
+        k = k_ref[0].astype(jnp.float32)  # [block_k, d]
+        v = v_ref[0].astype(jnp.float32)  # [block_k, d]
 
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * sm_scale  # [block_q, block_k]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale  # [block_q, block_k]
+
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+        m_prev = m_scratch[...]  # [block_q, 128] (value replicated over lanes)
+        l_prev = l_scratch[...]
+        m_cur = jnp.max(s, axis=1, keepdims=True)  # [block_q, 1]
+        m_cur = jnp.broadcast_to(m_cur, m_prev.shape)
+        m_new = jnp.maximum(m_prev, m_cur)
+
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :1])  # [block_q, block_k]
+        if causal:
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        l_new = l_prev * alpha + jnp.broadcast_to(
+            jnp.sum(p, axis=1, keepdims=True), l_prev.shape
+        )
+
+        acc_scratch[...] = acc_scratch[...] * alpha[:, :1] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_scratch[...] = m_new
+        l_scratch[...] = l_new
 
     if causal:
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
-        )
-        k_pos = kj * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-
-    m_prev = m_scratch[...]  # [block_q, 128] (value replicated over lanes)
-    l_prev = l_scratch[...]
-    m_cur = jnp.max(s, axis=1, keepdims=True)  # [block_q, 1]
-    m_cur = jnp.broadcast_to(m_cur, m_prev.shape)
-    m_new = jnp.maximum(m_prev, m_cur)
-
-    alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new[:, :1])  # [block_q, block_k]
-    if causal:
-        p = jnp.where(q_pos >= k_pos, p, 0.0)
-    l_new = l_prev * alpha + jnp.broadcast_to(
-        jnp.sum(p, axis=1, keepdims=True), l_prev.shape
-    )
-
-    acc_scratch[...] = acc_scratch[...] * alpha[:, :1] + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    m_scratch[...] = m_new
-    l_scratch[...] = l_new
+        # Skip k-blocks strictly in the future of every query in this
+        # q-block (the whole block would be masked) — halves FLOPs for
+        # causal attention.
+        @pl.when(kj * block_k < (qi + 1) * block_q)
+        def _():
+            _compute()
+    else:
+        _compute()
 
     @pl.when(kj == num_k_blocks - 1)
     def _finish():

@@ -71,7 +71,10 @@ def restore_checkpoint(path: str, like: Any, *, root_rank: int = 0) -> Any:
             like,
         )
         restored = _checkpointer().restore(path, item=template)
-    except Exception:
+    except (TypeError, ValueError):
+        # Older orbax versions reject ShapeDtypeStruct templates; fall back to
+        # a concrete-host-array template. Genuine restore errors (missing or
+        # corrupt checkpoint) raise other exception types and propagate.
         restored = _checkpointer().restore(
             path,
             item=jax.tree_util.tree_map(

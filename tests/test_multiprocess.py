@@ -27,6 +27,8 @@ def test_two_process_world():
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # worker sets its own (1 device per process)
     env["JAX_PLATFORMS"] = "cpu"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
 
     procs = [
         subprocess.Popen(
@@ -39,10 +41,18 @@ def test_two_process_world():
         for i in range(nprocs)
     ]
     outputs = []
-    for i, p in enumerate(procs):
-        out, _ = p.communicate(timeout=240)
-        outputs.append(out)
-        assert p.returncode == 0, f"rank {i} failed:\n{out}"
+    try:
+        for i, p in enumerate(procs):
+            out, _ = p.communicate(timeout=240)
+            outputs.append(out)
+            assert p.returncode == 0, f"rank {i} failed:\n{out}"
+    finally:
+        # A failed/hung rank must not leave its peers blocked in a collective
+        # holding the coordinator port.
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
     for i, out in enumerate(outputs):
         assert f"WORKER_{i}_OK" in out
     # rank-tagged printing made it out of at least the lead rank
