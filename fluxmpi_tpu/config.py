@@ -43,6 +43,8 @@ _DEFAULTS: dict[str, Any] = {
     "dp_axis_name": "dp",
     # Default name of the sequence-parallel mesh axis (ring attention).
     "sp_axis_name": "sp",
+    # Default name of the tensor-parallel mesh axis (sharded matmuls).
+    "tp_axis_name": "tp",
 }
 
 
@@ -156,3 +158,4 @@ _warn_deprecated_env()
 DEVICE_COLLECTIVES_DISABLED: bool = bool(load_preference("disable_device_collectives"))
 DP_AXIS_NAME: str = str(load_preference("dp_axis_name"))
 SP_AXIS_NAME: str = str(load_preference("sp_axis_name"))
+TP_AXIS_NAME: str = str(load_preference("tp_axis_name"))

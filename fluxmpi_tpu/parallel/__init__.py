@@ -7,4 +7,12 @@ from .collectives import (  # noqa: F401
     pmean_tree,
     psum_tree,
 )
+from .sharding import (  # noqa: F401
+    combine_rules,
+    fsdp_rule,
+    rule_from_table,
+    shard_tree,
+    transformer_tp_rules,
+    tree_partition_specs,
+)
 from .train import TrainState, make_train_step  # noqa: F401

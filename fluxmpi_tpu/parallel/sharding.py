@@ -1,0 +1,210 @@
+"""Parameter/optimizer sharding rules — tensor parallelism and FSDP/ZeRO.
+
+The reference framework replicates every parameter and the full optimizer
+state on every rank (reference: src/synchronize.jl:10-35 broadcasts the whole
+tree; SURVEY.md §2 "ZeRO/FSDP-style optimizer sharding: No"). On TPU the mesh
+makes richer layouts one declaration away: assign each parameter leaf a
+:class:`~jax.sharding.PartitionSpec` and let XLA's SPMD partitioner insert
+the all-gathers / reduce-scatters over ICI. This module is that declaration
+layer:
+
+- a **rule** is ``rule(path, shape) -> PartitionSpec | None`` — ``None``
+  means "no opinion" (composable via :func:`combine_rules`);
+- :func:`fsdp_rule` shards the largest divisible axis of every big leaf over
+  the data-parallel axis (ZeRO-3-style parameter + optimizer sharding);
+- :func:`transformer_tp_rules` is a path-table rule producing Megatron-style
+  column/row-parallel layouts for :class:`fluxmpi_tpu.models.TransformerLM`;
+- :func:`tree_partition_specs` / :func:`shard_tree` apply a rule to a whole
+  pytree (parameters *and* optax optimizer state — optimizer moments carry
+  the parameter path as a suffix of their own path, so one rule shards both
+  consistently).
+
+These compose with the data/sequence axes in one mesh, e.g.
+``fm.init(mesh_shape={"dp": 2, "sp": 2, "tp": 2})``, and feed
+``make_train_step(..., state_sharding=...)``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import config
+
+__all__ = [
+    "Rule",
+    "combine_rules",
+    "rule_from_table",
+    "fsdp_rule",
+    "transformer_tp_rules",
+    "tree_partition_specs",
+    "shard_tree",
+]
+
+# A sharding rule: (leaf path like "encoder/block_0/ff1/kernel", leaf shape)
+# -> PartitionSpec, or None for "no opinion".
+Rule = Callable[[str, tuple[int, ...]], P | None]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for entry in path:
+        if hasattr(entry, "key"):
+            parts.append(str(entry.key))
+        elif hasattr(entry, "name"):
+            parts.append(str(entry.name))
+        elif hasattr(entry, "idx"):
+            parts.append(str(entry.idx))
+        else:  # pragma: no cover - future jax key types
+            parts.append(str(entry))
+    return "/".join(parts)
+
+
+def combine_rules(*rules: Rule) -> Rule:
+    """First rule with an opinion wins (e.g. TP table first, FSDP fallback)."""
+
+    def rule(path: str, shape: tuple[int, ...]) -> P | None:
+        for r in rules:
+            spec = r(path, shape)
+            if spec is not None:
+                return spec
+        return None
+
+    return rule
+
+
+def rule_from_table(table: Sequence[tuple[str, P]]) -> Rule:
+    """Build a rule from ``(regex, spec)`` pairs matched against the leaf
+    path (``re.search``; first match wins)."""
+    compiled = [(re.compile(pat), spec) for pat, spec in table]
+
+    def rule(path: str, shape: tuple[int, ...]) -> P | None:
+        for pat, spec in compiled:
+            if pat.search(path):
+                return spec
+        return None
+
+    return rule
+
+
+def fsdp_rule(
+    mesh: Mesh,
+    *,
+    axis_name: str | None = None,
+    min_size: int = 1024,
+) -> Rule:
+    """ZeRO-3-style rule: shard the largest mesh-divisible dimension of every
+    leaf with ``size >= min_size`` over the data-parallel axis.
+
+    Applied to parameters AND optimizer state this shards weights, Adam
+    moments, etc. — each device holds ``1/dp`` of everything, and XLA
+    all-gathers weights on use / reduce-scatters gradients, both riding ICI.
+    Leaves below ``min_size`` (biases, scales, scalars) stay replicated —
+    sharding them would cost more in collective latency than it saves.
+    """
+    name = axis_name or config.DP_AXIS_NAME
+    axis_size = mesh.shape[name]
+
+    def rule(path: str, shape: tuple[int, ...]) -> P | None:
+        if int(np.prod(shape or (1,))) < min_size:
+            return None
+        divisible = [d for d in range(len(shape)) if shape[d] % axis_size == 0]
+        if not divisible:
+            return None
+        dim = max(divisible, key=lambda d: shape[d])
+        spec = [None] * len(shape)
+        spec[dim] = name
+        return P(*spec)
+
+    return rule
+
+
+def transformer_tp_rules(tp_axis: str | None = None) -> Rule:
+    """Megatron-style tensor-parallel layout for the in-repo transformer
+    models (:class:`fluxmpi_tpu.models.TransformerLM` /
+    :class:`TransformerEncoder`):
+
+    - attention Q/K/V projections: heads dimension column-parallel;
+    - attention output projection: heads dimension row-parallel;
+    - MLP ``ff1`` column-parallel, ``ff2`` row-parallel (the canonical
+      pattern — one all-reduce per block instead of one per matmul);
+    - token embedding: vocab-parallel.
+
+    XLA's SPMD partitioner derives the matching activation shardings and
+    inserts the block-boundary all-reduces over ICI.
+    """
+    tp = tp_axis or config.TP_AXIS_NAME
+    return rule_from_table(
+        [
+            # flax MultiHeadDotProductAttention params:
+            #   {query,key,value}/kernel: (d_model, heads, head_dim)
+            #   out/kernel:               (heads, head_dim, d_model)
+            (r"attn/(query|key|value)/kernel$", P(None, tp, None)),
+            (r"attn/(query|key|value)/bias$", P(tp, None)),
+            (r"attn/out/kernel$", P(tp, None, None)),
+            # MLP: ff1 (d_model, d_ff) column-parallel; ff2 (d_ff, d_model)
+            # row-parallel.
+            (r"ff1/kernel$", P(None, tp)),
+            (r"ff1/bias$", P(tp)),
+            (r"ff2/kernel$", P(tp, None)),
+            # Token embedding (vocab, d_model): vocab-parallel; the LM head
+            # (embed.attend) becomes a vocab-sharded matmul + gather.
+            (r"embed/embedding$", P(tp, None)),
+        ]
+    )
+
+
+def _validated(spec: P | None, shape: tuple[int, ...], mesh: Mesh) -> P:
+    """Clamp a rule's spec to what the leaf shape actually supports:
+    mismatched rank or non-divisible dims degrade to replicated on that dim
+    rather than failing at compile time."""
+    if spec is None:
+        return P()
+    if len(spec) > len(shape):
+        return P()
+    out = []
+    for d, names in enumerate(tuple(spec) + (None,) * (len(shape) - len(spec))):
+        if names is None:
+            out.append(None)
+            continue
+        group = (names,) if isinstance(names, str) else tuple(names)
+        if any(n not in mesh.shape for n in group):
+            out.append(None)
+            continue
+        size = int(np.prod([mesh.shape[n] for n in group]))
+        out.append(names if shape[d] % size == 0 else None)
+    return P(*out)
+
+
+def tree_partition_specs(tree: Any, mesh: Mesh, rule: Rule) -> Any:
+    """Map a rule over a pytree → pytree of validated PartitionSpecs."""
+
+    def leaf_spec(path, leaf):
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        if not shape:
+            return P()
+        return _validated(rule(_path_str(path), shape), shape, mesh)
+
+    return jax.tree_util.tree_map_with_path(leaf_spec, tree)
+
+
+def shard_tree(tree: Any, mesh: Mesh, rule: Rule) -> tuple[Any, Any]:
+    """Lay a pytree out over the mesh per ``rule``.
+
+    Returns ``(placed_tree, shardings)`` where ``shardings`` is the matching
+    pytree of :class:`NamedSharding` (feed it to
+    ``make_train_step(state_sharding=...)``).
+    """
+    specs = tree_partition_specs(tree, mesh, rule)
+    shardings = jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    placed = jax.tree_util.tree_map(jax.device_put, tree, shardings)
+    return placed, shardings

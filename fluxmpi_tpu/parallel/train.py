@@ -82,11 +82,22 @@ def replicate(tree: Any, mesh: Mesh | None = None) -> Any:
     )
 
 
-def shard_batch(batch: Any, mesh: Mesh | None = None, axis_name: str | None = None) -> Any:
-    """Lay a host batch out sharded over the data-parallel axis."""
+def shard_batch(
+    batch: Any,
+    mesh: Mesh | None = None,
+    axis_name: str | None = None,
+    *,
+    spec: P | None = None,
+) -> Any:
+    """Lay a host batch out over the mesh — by default the leading (batch)
+    dimension over the data-parallel axis; pass ``spec`` for richer layouts
+    (e.g. ``P("dp", "sp")`` to also shard the sequence dimension)."""
     mesh = mesh or global_mesh()
-    name = axis_name or config.DP_AXIS_NAME
-    sharding = NamedSharding(mesh, P(name))
+    if spec is not None and axis_name is not None:
+        raise ValueError("pass either axis_name or spec, not both")
+    if spec is None:
+        spec = P(axis_name or config.DP_AXIS_NAME)
+    sharding = NamedSharding(mesh, spec)
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)
 
 
@@ -100,6 +111,8 @@ def make_train_step(
     grad_reduce: str | None = "mean",
     state_reduce: str = "mean",
     donate: bool | None = None,
+    state_sharding: Any | None = None,
+    batch_spec: P | None = None,
 ) -> Callable[[TrainState, Any], tuple[TrainState, jax.Array]]:
     """Build a compiled data-parallel train step.
 
@@ -124,6 +137,14 @@ def make_train_step(
         SURVEY.md §7 hard parts).
       donate: donate the TrainState buffers (in-place update in HBM).
         Defaults to the ``donate_buffers`` preference.
+      state_sharding: optional pytree of :class:`NamedSharding` matching the
+        :class:`TrainState` (see :func:`fluxmpi_tpu.parallel.sharding.shard_tree`)
+        — enables tensor-parallel / FSDP parameter+optimizer layouts instead
+        of full replication. ``style="auto"`` only.
+      batch_spec: PartitionSpec for every batch leaf (default
+        ``P(axis_name)`` — batch dim over the data-parallel axis). Use e.g.
+        ``P("dp", "sp")`` to also shard the sequence dimension.
+        ``style="auto"`` only.
 
     Returns:
       ``step(state, batch) -> (new_state, loss)`` — compiled, collective
@@ -162,12 +183,20 @@ def make_train_step(
             return _apply_update(ts, grads, loss, new_mstate)
 
         replicated = NamedSharding(mesh, P())
-        batch_sharding = NamedSharding(mesh, P(name))
+        state_in = replicated if state_sharding is None else state_sharding
+        batch_sharding = NamedSharding(
+            mesh, P(name) if batch_spec is None else batch_spec
+        )
         return jax.jit(
             step,
-            in_shardings=(replicated, batch_sharding),
-            out_shardings=(replicated, replicated),
+            in_shardings=(state_in, batch_sharding),
+            out_shardings=(state_in, replicated),
             donate_argnums=(0,) if donate else (),
+        )
+    if state_sharding is not None or batch_spec is not None:
+        raise ValueError(
+            "state_sharding/batch_spec require style='auto' (shard_map style "
+            "replicates state per the reference's layout)"
         )
 
     # style == "shard_map": explicit per-device body. NOTE: shard_map's

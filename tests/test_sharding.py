@@ -1,0 +1,220 @@
+"""Sharding-rule tests: FSDP and tensor-parallel layouts match the serial
+oracle (the richer-layout extension of the reference's optimizer equivalence
+oracle, test/test_optimizer.jl:20-26 — the reference itself only ever
+replicates, SURVEY.md §2 parallelism inventory)."""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _mesh(world, shape):
+    devs = np.asarray(jax.devices()).reshape(tuple(shape.values()))
+    return Mesh(devs, tuple(shape.keys()))
+
+
+def _is_sharded(leaf):
+    return any(axis is not None for axis in tuple(leaf.sharding.spec))
+
+
+def test_fsdp_matches_serial(world):
+    from fluxmpi_tpu.models import MLP
+    from fluxmpi_tpu.parallel import TrainState, fsdp_rule, make_train_step, shard_tree
+    from fluxmpi_tpu.parallel.train import shard_batch
+
+    mesh = _mesh(world, {"dp": 8})
+    model = MLP(features=(16, 16, 1))
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 2)))
+    optimizer = optax.adam(0.05)
+    state = TrainState.create(params, optimizer)
+
+    def loss_fn(p, mstate, batch):
+        x, y = batch
+        return jnp.mean((model.apply(p, x) - y) ** 2), mstate
+
+    rule = fsdp_rule(mesh, min_size=16)
+    sharded_state, shardings = shard_tree(state, mesh, rule)
+    # The big kernels must actually be sharded, and Adam's moments must
+    # follow the same layout (ZeRO: optimizer state sharded too).
+    assert _is_sharded(sharded_state.params["params"]["dense_0"]["kernel"])
+    mu = sharded_state.opt_state[0].mu["params"]["dense_0"]["kernel"]
+    assert _is_sharded(mu)
+
+    step = make_train_step(
+        loss_fn, optimizer, mesh=mesh, state_sharding=shardings, donate=False
+    )
+    rng = np.random.default_rng(1)
+    batch = (
+        rng.normal(size=(16, 2)).astype(np.float32),
+        rng.normal(size=(16, 1)).astype(np.float32),
+    )
+    new_state, loss = step(sharded_state, shard_batch(batch, mesh))
+
+    (sloss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, None, batch)
+    updates, _ = optimizer.update(grads, optimizer.init(params), params)
+    serial_params = optax.apply_updates(params, updates)
+
+    np.testing.assert_allclose(float(loss), float(sloss), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        ),
+        new_state.params,
+        serial_params,
+    )
+    # Output layout is preserved: still sharded after the update.
+    assert _is_sharded(new_state.params["params"]["dense_0"]["kernel"])
+
+
+def _tiny_lm():
+    from fluxmpi_tpu.models import TransformerLM
+
+    return TransformerLM(
+        vocab_size=64,
+        max_len=32,
+        num_layers=2,
+        d_model=32,
+        num_heads=4,
+        d_ff=64,
+    )
+
+
+def _lm_loss(model):
+    def loss_fn(p, mstate, batch):
+        tokens, targets = batch
+        logits = model.apply(p, tokens, train=False)
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        return jnp.mean(loss), mstate
+
+    return loss_fn
+
+
+def test_tp_transformer_matches_serial(world):
+    from fluxmpi_tpu.parallel import (
+        TrainState,
+        make_train_step,
+        shard_tree,
+        transformer_tp_rules,
+    )
+    from fluxmpi_tpu.parallel.train import shard_batch
+
+    mesh = _mesh(world, {"dp": 2, "tp": 4})
+    model = _tiny_lm()
+    tokens = jnp.ones((4, 16), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens, train=False)
+    optimizer = optax.sgd(0.1)
+    state = TrainState.create(params, optimizer)
+    loss_fn = _lm_loss(model)
+
+    sharded_state, shardings = shard_tree(state, mesh, transformer_tp_rules())
+    blk = sharded_state.params["params"]["encoder"]["block_0"]
+    assert tuple(blk["ff1"]["kernel"].sharding.spec) == (None, "tp")
+    assert tuple(blk["attn"]["out"]["kernel"].sharding.spec) == ("tp", None, None)
+    assert tuple(
+        sharded_state.params["params"]["embed"]["embedding"].sharding.spec
+    ) == ("tp", None)
+
+    step = make_train_step(
+        loss_fn,
+        optimizer,
+        mesh=mesh,
+        state_sharding=shardings,
+        batch_spec=P("dp"),
+        donate=False,
+    )
+    rng = np.random.default_rng(2)
+    batch = (
+        rng.integers(0, 64, size=(8, 16)).astype(np.int32),
+        rng.integers(0, 64, size=(8, 16)).astype(np.int32),
+    )
+    new_state, loss = step(
+        sharded_state, shard_batch(batch, mesh, axis_name="dp")
+    )
+
+    (sloss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, None, batch)
+    updates, _ = optimizer.update(grads, optimizer.init(params), params)
+    serial_params = optax.apply_updates(params, updates)
+
+    np.testing.assert_allclose(float(loss), float(sloss), rtol=1e-4)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5
+        ),
+        new_state.params,
+        serial_params,
+    )
+
+
+def test_tp_fsdp_sp_composed(world):
+    """Full 3-axis layout: dp×sp×tp mesh, TP table + FSDP fallback, batch
+    sharded over dp AND sequence over sp — one compiled step, finite loss."""
+    from fluxmpi_tpu.parallel import (
+        TrainState,
+        combine_rules,
+        fsdp_rule,
+        make_train_step,
+        shard_tree,
+        transformer_tp_rules,
+    )
+    from fluxmpi_tpu.parallel.train import shard_batch
+
+    mesh = _mesh(world, {"dp": 2, "sp": 2, "tp": 2})
+    model = _tiny_lm()
+    tokens = jnp.ones((4, 16), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens, train=False)
+    optimizer = optax.adam(1e-2)
+    state = TrainState.create(params, optimizer)
+
+    rule = combine_rules(transformer_tp_rules(), fsdp_rule(mesh, min_size=256))
+    sharded_state, shardings = shard_tree(state, mesh, rule)
+
+    step = make_train_step(
+        _lm_loss(model),
+        optimizer,
+        mesh=mesh,
+        state_sharding=shardings,
+        batch_spec=P("dp", "sp"),
+        donate=False,
+    )
+    rng = np.random.default_rng(3)
+    batch = (
+        rng.integers(0, 64, size=(4, 16)).astype(np.int32),
+        rng.integers(0, 64, size=(4, 16)).astype(np.int32),
+    )
+    new_state, loss = step(
+        sharded_state, shard_batch(batch, mesh, spec=P("dp", "sp"))
+    )
+    assert np.isfinite(float(loss))
+    assert int(new_state.step) == 1
+
+
+def test_rule_validation_degrades_to_replicated(world):
+    """Specs that don't divide the leaf shape fall back to replicated dims
+    instead of failing at compile time."""
+    from fluxmpi_tpu.parallel.sharding import rule_from_table, tree_partition_specs
+
+    mesh = _mesh(world, {"dp": 8})
+    tree = {"w": jnp.ones((6, 4)), "b": jnp.ones((3,))}
+    rule = rule_from_table([(r".*", P("dp"))])
+    specs = tree_partition_specs(tree, mesh, rule)
+    assert all(a is None for a in tuple(specs["w"]))
+    assert all(a is None for a in tuple(specs["b"]))
+
+    tree2 = {"w": jnp.ones((16, 4))}
+    specs2 = tree_partition_specs(tree2, mesh, rule)
+    assert tuple(specs2["w"])[0] == "dp"
+
+
+def test_fsdp_rule_min_size(world):
+    from fluxmpi_tpu.parallel import fsdp_rule
+
+    mesh = _mesh(world, {"dp": 8})
+    rule = fsdp_rule(mesh, min_size=1024)
+    assert rule("small/bias", (8,)) is None
+    assert rule("big/kernel", (64, 64)) == P("dp", None)
+    # largest divisible dim wins
+    assert rule("big/kernel", (64, 128)) == P(None, "dp")
