@@ -45,6 +45,10 @@ _DEFAULTS: dict[str, Any] = {
     "sp_axis_name": "sp",
     # Default name of the tensor-parallel mesh axis (sharded matmuls).
     "tp_axis_name": "tp",
+    # Default name of the expert-parallel mesh axis (MoE experts).
+    "ep_axis_name": "ep",
+    # Default name of the pipeline-parallel mesh axis (GPipe stages).
+    "pp_axis_name": "pp",
 }
 
 
@@ -159,3 +163,5 @@ DEVICE_COLLECTIVES_DISABLED: bool = bool(load_preference("disable_device_collect
 DP_AXIS_NAME: str = str(load_preference("dp_axis_name"))
 SP_AXIS_NAME: str = str(load_preference("sp_axis_name"))
 TP_AXIS_NAME: str = str(load_preference("tp_axis_name"))
+EP_AXIS_NAME: str = str(load_preference("ep_axis_name"))
+PP_AXIS_NAME: str = str(load_preference("pp_axis_name"))

@@ -9,6 +9,12 @@
 
 from .mlp import MLP  # noqa: F401
 from .cnn import CNN  # noqa: F401
+from .moe import (  # noqa: F401
+    MoEEncoderBlock,
+    MoEMLP,
+    MoETransformerLM,
+    expert_parallel_rules,
+)
 from .resnet import (  # noqa: F401
     ResNet,
     ResNet18,

@@ -1,0 +1,199 @@
+"""Mixture-of-experts layers with expert parallelism over the mesh.
+
+The reference framework has no MoE (SURVEY.md §2 parallelism inventory:
+"Expert parallelism (EP/MoE): No"); this is a capability extension in the
+same spirit as ring attention — the mesh design makes a new axis one
+declaration away. The layer is Switch-Transformer-style top-1 routing with
+static capacity, built entirely from dense einsums over static shapes so XLA
+can tile everything onto the MXU:
+
+- routing is a one-hot dispatch tensor ``[tokens, experts, capacity]``
+  (no gather/scatter, no dynamic shapes — the TPU-friendly formulation);
+- expert weights carry a leading ``num_experts`` dimension; shard it over an
+  ``ep`` mesh axis (:func:`expert_parallel_rules`) and XLA turns the
+  dispatch/combine einsums into all-to-alls over ICI;
+- tokens over capacity are dropped (their combine weight is zero and the
+  residual connection carries them through unchanged) — the standard Switch
+  trade for static shapes;
+- the load-balancing auxiliary loss (router probs × token fractions) is
+  sowed under the ``"losses"`` collection; pull it out with
+  ``mutable=["losses"]`` and add it to the task loss.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .transformer import EncoderBlock, TransformerEncoder, TransformerLM
+
+__all__ = [
+    "MoEMLP",
+    "MoEEncoderBlock",
+    "MoEEncoder",
+    "MoETransformerLM",
+    "expert_parallel_rules",
+]
+
+
+class MoEMLP(nn.Module):
+    """Top-1 (Switch) mixture-of-experts feed-forward layer.
+
+    Input/output ``(..., d_model)``; tokens = all leading dims flattened.
+    ``capacity_factor`` scales per-expert capacity
+    ``ceil(tokens / num_experts * capacity_factor)``.
+    """
+
+    num_experts: int = 8
+    d_ff: int = 256
+    capacity_factor: float = 1.25
+    dtype: jnp.dtype = jnp.float32
+    router_noise: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = True):
+        *lead, d_model = x.shape
+        n_tokens = 1
+        for s in lead:
+            n_tokens *= s
+        tokens = x.reshape(n_tokens, d_model).astype(self.dtype)
+
+        # Router (kept in f32: tiny, and argmax/softmax stability matters).
+        router_w = self.param(
+            "router", nn.initializers.lecun_normal(), (d_model, self.num_experts)
+        )
+        logits = (tokens.astype(jnp.float32) @ router_w.astype(jnp.float32))
+        if self.router_noise > 0.0 and train:
+            rng = self.make_rng("router")
+            logits = logits + self.router_noise * jax.random.normal(
+                rng, logits.shape
+            )
+        probs = jax.nn.softmax(logits, axis=-1)  # [N, E]
+        expert_idx = jnp.argmax(probs, axis=-1)  # [N]
+        expert_gate = jnp.take_along_axis(
+            probs, expert_idx[:, None], axis=-1
+        )[:, 0]  # [N]
+
+        capacity = max(
+            1, int(-(-n_tokens * self.capacity_factor // self.num_experts))
+        )
+        onehot = jax.nn.one_hot(expert_idx, self.num_experts, dtype=jnp.float32)
+        # Position of each token within its expert's buffer (0-based).
+        pos_in_expert = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # [N, E]
+        kept = (pos_in_expert < capacity) & (onehot > 0)  # [N, E] bool
+        pos_oh = jax.nn.one_hot(
+            pos_in_expert.astype(jnp.int32), capacity, dtype=jnp.float32
+        )  # [N, E, C]
+        dispatch = pos_oh * kept[..., None].astype(jnp.float32)  # [N, E, C]
+        combine = dispatch * expert_gate[:, None, None]  # [N, E, C]
+
+        # Load-balancing aux loss (Switch eq. 4): E * sum_e f_e * P_e.
+        frac_tokens = jnp.mean(onehot, axis=0)
+        frac_probs = jnp.mean(probs, axis=0)
+        aux_loss = self.num_experts * jnp.sum(frac_tokens * frac_probs)
+        self.sow("losses", "moe_aux_loss", aux_loss)
+
+        w1 = self.param(
+            "w1",
+            nn.initializers.lecun_normal(),
+            (self.num_experts, d_model, self.d_ff),
+        )
+        b1 = self.param("b1", nn.initializers.zeros, (self.num_experts, self.d_ff))
+        w2 = self.param(
+            "w2",
+            nn.initializers.lecun_normal(),
+            (self.num_experts, self.d_ff, d_model),
+        )
+        b2 = self.param("b2", nn.initializers.zeros, (self.num_experts, d_model))
+
+        expert_in = jnp.einsum(
+            "nec,nd->ecd", dispatch.astype(self.dtype), tokens
+        )  # [E, C, d_model]
+        h = jnp.einsum("ecd,edf->ecf", expert_in, w1.astype(self.dtype))
+        h = nn.gelu(h + b1[:, None, :].astype(self.dtype))
+        out = jnp.einsum("ecf,efd->ecd", h, w2.astype(self.dtype))
+        out = out + b2[:, None, :].astype(self.dtype)
+        y = jnp.einsum("nec,ecd->nd", combine.astype(self.dtype), out)
+        return y.reshape(*lead, d_model).astype(x.dtype)
+
+
+class MoEEncoderBlock(EncoderBlock):
+    """Pre-LN encoder block whose feed-forward sublayer is a Switch MoE
+    (attention/norm/residual structure inherited from
+    :class:`fluxmpi_tpu.models.transformer.EncoderBlock`)."""
+
+    num_experts: int = 8
+    capacity_factor: float = 1.25
+
+    def make_ff(self) -> nn.Module:
+        return MoEMLP(
+            num_experts=self.num_experts,
+            d_ff=self.d_ff,
+            capacity_factor=self.capacity_factor,
+            dtype=self.dtype,
+            name="moe",
+        )
+
+
+class MoEEncoder(TransformerEncoder):
+    """Encoder stack of :class:`MoEEncoderBlock`."""
+
+    num_experts: int = 8
+    capacity_factor: float = 1.25
+
+    def make_block(self, i: int) -> nn.Module:
+        return MoEEncoderBlock(
+            d_model=self.d_model,
+            num_heads=self.num_heads,
+            d_ff=self.d_ff,
+            dropout=self.dropout,
+            dtype=self.dtype,
+            attention_fn=self.attention_fn,
+            num_experts=self.num_experts,
+            capacity_factor=self.capacity_factor,
+            name=f"block_{i}",
+        )
+
+
+class MoETransformerLM(TransformerLM):
+    """Token LM where every block's feed-forward is a Switch MoE layer
+    (embedding/positions/LM-head inherited from
+    :class:`fluxmpi_tpu.models.transformer.TransformerLM`; expert weights
+    live at ``encoder/block_i/moe/{w1,b1,w2,b2}``)."""
+
+    num_experts: int = 8
+    capacity_factor: float = 1.25
+
+    def make_encoder(self) -> nn.Module:
+        return MoEEncoder(
+            num_layers=self.num_layers,
+            d_model=self.d_model,
+            num_heads=self.num_heads,
+            d_ff=self.d_ff,
+            dropout=self.dropout,
+            dtype=self.dtype,
+            attention_fn=self.attention_fn,
+            num_experts=self.num_experts,
+            capacity_factor=self.capacity_factor,
+            name="encoder",
+        )
+
+
+def expert_parallel_rules(ep_axis: str | None = None):
+    """Sharding rule laying the leading ``num_experts`` dimension of every
+    MoE expert weight over the ``ep`` mesh axis (the router stays
+    replicated). Compose with :func:`fluxmpi_tpu.parallel.transformer_tp_rules`
+    / :func:`fluxmpi_tpu.parallel.fsdp_rule` via ``combine_rules``."""
+    from jax.sharding import PartitionSpec as P
+
+    from .. import config
+    from ..parallel.sharding import rule_from_table
+
+    ep = ep_axis or config.EP_AXIS_NAME
+    return rule_from_table(
+        [
+            (r"moe/(w1|w2)$", P(ep, None, None)),
+            (r"moe/(b1|b2)$", P(ep, None)),
+        ]
+    )

@@ -32,6 +32,13 @@ class EncoderBlock(nn.Module):
     dtype: jnp.dtype
     attention_fn: Callable | None = None
 
+    def make_ff(self) -> nn.Module | None:
+        """Hook: return a module for the feed-forward sublayer (called as
+        ``ff(h, train=train)``), or ``None`` for the default dense MLP.
+        Subclasses swap in alternatives (e.g. a mixture-of-experts layer,
+        :class:`fluxmpi_tpu.models.moe.MoEEncoderBlock`)."""
+        return None
+
     @nn.compact
     def __call__(self, x, *, train: bool = True, mask=None):
         attn_kwargs = {}
@@ -48,9 +55,13 @@ class EncoderBlock(nn.Module):
         )(h, h, mask=mask)
         x = x + h
         h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
-        h = nn.Dense(self.d_ff, dtype=self.dtype, name="ff1")(h)
-        h = nn.gelu(h)
-        h = nn.Dense(self.d_model, dtype=self.dtype, name="ff2")(h)
+        ff = self.make_ff()
+        if ff is None:
+            h = nn.Dense(self.d_ff, dtype=self.dtype, name="ff1")(h)
+            h = nn.gelu(h)
+            h = nn.Dense(self.d_model, dtype=self.dtype, name="ff2")(h)
+        else:
+            h = ff(h, train=train)
         return x + h
 
 
@@ -66,25 +77,30 @@ class TransformerEncoder(nn.Module):
     dtype: jnp.dtype = jnp.float32
     attention_fn: Callable | None = None
 
+    def make_block(self, i: int) -> nn.Module:
+        """Hook: build encoder block ``i`` (subclasses swap the block type)."""
+        return EncoderBlock(
+            d_model=self.d_model,
+            num_heads=self.num_heads,
+            d_ff=self.d_ff,
+            dropout=self.dropout,
+            dtype=self.dtype,
+            attention_fn=self.attention_fn,
+            name=f"block_{i}",
+        )
+
     @nn.compact
     def __call__(self, x, *, train: bool = True, mask=None):
         x = x.astype(self.dtype)
         for i in range(self.num_layers):
-            x = EncoderBlock(
-                d_model=self.d_model,
-                num_heads=self.num_heads,
-                d_ff=self.d_ff,
-                dropout=self.dropout,
-                dtype=self.dtype,
-                attention_fn=self.attention_fn,
-                name=f"block_{i}",
-            )(x, train=train, mask=mask)
+            x = self.make_block(i)(x, train=train, mask=mask)
         return nn.LayerNorm(dtype=jnp.float32, name="ln_out")(x)
 
 
 class TransformerLM(nn.Module):
     """Token-level wrapper: embedding + learned positions + encoder + LM
-    head (weight-tied)."""
+    head (weight-tied). Subclasses override :meth:`make_encoder` to swap the
+    block type (e.g. :class:`fluxmpi_tpu.models.moe.MoETransformerLM`)."""
 
     vocab_size: int = 1024
     max_len: int = 512
@@ -95,6 +111,19 @@ class TransformerLM(nn.Module):
     dropout: float = 0.0
     dtype: jnp.dtype = jnp.float32
     attention_fn: Callable | None = None
+
+    def make_encoder(self) -> nn.Module:
+        """Hook: build the encoder stack (subclasses swap the block type)."""
+        return TransformerEncoder(
+            num_layers=self.num_layers,
+            d_model=self.d_model,
+            num_heads=self.num_heads,
+            d_ff=self.d_ff,
+            dropout=self.dropout,
+            dtype=self.dtype,
+            attention_fn=self.attention_fn,
+            name="encoder",
+        )
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = True):
@@ -108,14 +137,5 @@ class TransformerLM(nn.Module):
         x = embed(tokens) + pos[:seq][None, :, :].astype(self.dtype)
         # causal mask
         mask = nn.make_causal_mask(tokens)
-        x = TransformerEncoder(
-            num_layers=self.num_layers,
-            d_model=self.d_model,
-            num_heads=self.num_heads,
-            d_ff=self.d_ff,
-            dropout=self.dropout,
-            dtype=self.dtype,
-            attention_fn=self.attention_fn,
-            name="encoder",
-        )(x, train=train, mask=mask)
+        x = self.make_encoder()(x, train=train, mask=mask)
         return embed.attend(x.astype(jnp.float32))

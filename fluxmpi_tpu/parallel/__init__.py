@@ -7,6 +7,12 @@ from .collectives import (  # noqa: F401
     pmean_tree,
     psum_tree,
 )
+from .pipeline import (  # noqa: F401
+    make_pipeline_fn,
+    pipeline_apply,
+    pipeline_rules,
+    stack_stage_params,
+)
 from .sharding import (  # noqa: F401
     combine_rules,
     fsdp_rule,

@@ -1,0 +1,173 @@
+"""Pipeline parallelism — GPipe-style stage partitioning over a mesh axis.
+
+No reference analogue (SURVEY.md §2: "Pipeline parallelism: No"); like ring
+attention and MoE expert parallelism, this extends the mesh design with one
+more named axis. The formulation is TPU-idiomatic SPMD:
+
+- every stage's parameters carry a leading ``n_stages`` dimension sharded
+  over the ``pp`` axis (one stage per device along that axis);
+- the whole schedule is ONE compiled ``lax.scan`` over ``M + S - 1`` ticks
+  (M microbatches, S stages): every device runs the stage function every
+  tick (bubble ticks compute on garbage and are masked out — the standard
+  SPMD pipeline trade), activations hop to the next stage via
+  ``lax.ppermute`` (one ICI neighbor hop, exactly what the torus wants);
+- the last stage accumulates its outputs and a final ``psum`` over the axis
+  replicates them (all other stages contribute zeros);
+- everything is differentiable (``ppermute`` transposes to the reverse
+  permute), so the same schedule serves forward and backward — wrap the
+  loss in :func:`jax.grad` as usual.
+
+The inter-stage activation must have a fixed shape: ``stage_fn(params, x)
+-> y`` with ``y.shape == x.shape``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .. import config
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+__all__ = ["pipeline_apply", "make_pipeline_fn", "stack_stage_params", "pipeline_rules"]
+
+
+def stack_stage_params(stage_params_list: list[Any]) -> Any:
+    """Stack per-stage parameter pytrees into one tree whose leaves have a
+    leading ``n_stages`` dimension (shard it over the ``pp`` axis)."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *stage_params_list
+    )
+
+
+def pipeline_rules(pp_axis: str | None = None):
+    """Sharding rule for stacked stage parameters: leading (stage) dimension
+    over the ``pp`` mesh axis, everything else replicated."""
+    name = pp_axis or config.PP_AXIS_NAME
+
+    def rule(path: str, shape: tuple[int, ...]):
+        if not shape:
+            return None
+        return P(name, *([None] * (len(shape) - 1)))
+
+    return rule
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stacked_params: Any,
+    x: jax.Array,
+    *,
+    n_microbatches: int,
+    axis_name: str | None = None,
+):
+    """Run the stage-partitioned network over the bound ``pp`` axis.
+
+    Call INSIDE ``shard_map`` (or use :func:`make_pipeline_fn` for the jitted
+    wrapper). ``stacked_params`` leaves arrive stage-local (leading dim 1 —
+    the shard of the stacked tree); ``x`` is the full batch ``[B, ...]``,
+    ``B`` divisible by ``n_microbatches``.
+    """
+    axis_name = axis_name or config.PP_AXIS_NAME
+    n_stages = jax.lax.axis_size(axis_name)
+    stage_idx = jax.lax.axis_index(axis_name)
+    for leaf in jax.tree_util.tree_leaves(stacked_params):
+        if leaf.shape[0] != 1:
+            raise ValueError(
+                f"stacked stage leaf has local leading dim {leaf.shape[0]}, "
+                f"expected 1 — the stacked stage count must equal the "
+                f"'{axis_name}' axis size {n_stages}"
+            )
+    params_local = jax.tree_util.tree_map(lambda p: p[0], stacked_params)
+
+    batch = x.shape[0]
+    if batch % n_microbatches:
+        raise ValueError(
+            f"batch {batch} not divisible by n_microbatches {n_microbatches}"
+        )
+    mb = batch // n_microbatches
+    x_mb = x.reshape(n_microbatches, mb, *x.shape[1:])
+
+    n_ticks = n_microbatches + n_stages - 1
+    fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+    def tick(carry, t):
+        act, acc = carry
+        # Stage 0 reads microbatch t from the input stream (clamped index —
+        # past the last microbatch it computes on a stale copy and the
+        # result is never written); later stages read the ppermuted
+        # activation from the previous stage.
+        inp = jnp.where(
+            stage_idx == 0, x_mb[jnp.minimum(t, n_microbatches - 1)], act
+        )
+        out = stage_fn(params_local, inp)
+        # The last stage finishes microbatch (t - (S-1)) at tick t.
+        widx = t - (n_stages - 1)
+        valid = jnp.logical_and(stage_idx == n_stages - 1, widx >= 0)
+        acc_written = jax.lax.dynamic_update_index_in_dim(
+            acc, out, jnp.maximum(widx, 0), 0
+        )
+        acc = jnp.where(valid, acc_written, acc)
+        act_next = jax.lax.ppermute(out, axis_name, fwd_perm)
+        return (act_next, acc), None
+
+    act0 = jnp.zeros((mb, *x.shape[1:]), x.dtype)
+    acc0 = jnp.zeros((n_microbatches, mb, *x.shape[1:]), x.dtype)
+    (_, acc), _ = jax.lax.scan(tick, (act0, acc0), jnp.arange(n_ticks))
+
+    # Only the last stage holds real outputs; psum replicates them (other
+    # stages contribute zeros).
+    acc = jnp.where(stage_idx == n_stages - 1, acc, jnp.zeros_like(acc))
+    acc = jax.lax.psum(acc, axis_name)
+    return acc.reshape(batch, *x.shape[1:])
+
+
+def make_pipeline_fn(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    mesh: Mesh | None = None,
+    *,
+    n_microbatches: int,
+    axis_name: str | None = None,
+):
+    """Jitted eager wrapper: ``fn(stacked_params, x) -> y`` with the stacked
+    stage dimension laid over ``axis_name`` and the batch replicated along
+    it. Differentiable — compose with ``jax.value_and_grad`` for training."""
+    from ..runtime import global_mesh
+
+    mesh = mesh or global_mesh()
+    axis_name = axis_name or config.PP_AXIS_NAME
+
+    def body(stacked_params, x):
+        return pipeline_apply(
+            stage_fn,
+            stacked_params,
+            x,
+            n_microbatches=n_microbatches,
+            axis_name=axis_name,
+        )
+
+    param_specs = P(axis_name)  # leading stage dim; rest replicated
+    try:
+        mapped = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(param_specs, P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+    except TypeError:  # pragma: no cover - older jax spells it check_rep
+        mapped = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(param_specs, P()),
+            out_specs=P(),
+            check_rep=False,
+        )
+    return jax.jit(mapped)

@@ -1,0 +1,142 @@
+"""MoE layer tests: routing algebra oracles + expert-parallel training.
+
+No reference analogue (SURVEY.md §2: expert parallelism absent there); the
+oracles follow the repo's test style — exact algebraic checks on tiny
+fixtures (single-expert equivalence, capacity overflow, aux-loss value) plus
+a compiled expert-parallel train step on the simulated mesh."""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _mesh(shape):
+    devs = np.asarray(jax.devices()).reshape(tuple(shape.values()))
+    return Mesh(devs, tuple(shape.keys()))
+
+
+def test_single_expert_matches_dense(world):
+    """With one expert and capacity >= tokens, MoE == a plain gelu MLP with
+    the expert's weights (gate prob is softmax over one logit == 1)."""
+    import flax.linen as nn
+
+    from fluxmpi_tpu.models import MoEMLP
+
+    model = MoEMLP(num_experts=1, d_ff=16, capacity_factor=2.0)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(3, 5, 8)), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x)
+    y = model.apply(params, x)
+
+    w1 = params["params"]["w1"][0]
+    b1 = params["params"]["b1"][0]
+    w2 = params["params"]["w2"][0]
+    b2 = params["params"]["b2"][0]
+    flat = x.reshape(-1, 8)
+    ref = nn.gelu(flat @ w1 + b1) @ w2 + b2
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(ref.reshape(3, 5, 8)), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_capacity_overflow_drops_tokens(world):
+    """Identical tokens all route to one expert; tokens beyond its capacity
+    get zero output (the residual path carries them in a full block)."""
+    from fluxmpi_tpu.models import MoEMLP
+
+    n_tokens, d = 8, 4
+    model = MoEMLP(num_experts=2, d_ff=8, capacity_factor=0.5)  # capacity 2
+    x = jnp.ones((1, n_tokens, d), jnp.float32)
+    params = model.init(jax.random.PRNGKey(1), x)
+    y = np.asarray(model.apply(params, x))[0]
+
+    norms = np.linalg.norm(y, axis=-1)
+    assert np.all(norms[:2] > 0), "tokens within capacity must be processed"
+    np.testing.assert_allclose(norms[2:], 0.0, atol=1e-7)
+
+
+def test_aux_loss_sowed(world):
+    from fluxmpi_tpu.models import MoEMLP
+
+    model = MoEMLP(num_experts=4, d_ff=8)
+    x = jnp.asarray(np.random.default_rng(2).normal(size=(2, 8, 8)), jnp.float32)
+    params = {"params": model.init(jax.random.PRNGKey(2), x)["params"]}
+    _, mutated = model.apply(params, x, mutable=["losses"])
+    (aux,) = jax.tree_util.tree_leaves(mutated["losses"])
+    # Switch aux loss is E * sum_e f_e P_e >= 1 with equality at perfect
+    # balance; must always be a finite positive scalar.
+    assert aux.shape == ()
+    assert float(aux) >= 0.99
+
+
+def test_expert_parallel_train_step(world):
+    """dp×ep mesh: expert weights sharded over ep, one compiled step."""
+    from fluxmpi_tpu.models import MoETransformerLM, expert_parallel_rules
+    from fluxmpi_tpu.parallel import (
+        TrainState,
+        combine_rules,
+        fsdp_rule,
+        make_train_step,
+        shard_tree,
+    )
+    from fluxmpi_tpu.parallel.train import shard_batch
+
+    mesh = _mesh({"dp": 2, "ep": 4})
+    model = MoETransformerLM(
+        vocab_size=64,
+        max_len=32,
+        num_layers=2,
+        d_model=32,
+        num_heads=4,
+        d_ff=64,
+        num_experts=4,
+    )
+    tokens = jnp.ones((4, 16), jnp.int32)
+    params = {
+        "params": model.init(jax.random.PRNGKey(0), tokens, train=False)["params"]
+    }
+    optimizer = optax.adam(1e-2)
+
+    rule = combine_rules(expert_parallel_rules(), fsdp_rule(mesh, min_size=512))
+    state, shardings = shard_tree(TrainState.create(params, optimizer), mesh, rule)
+    w1 = state.params["params"]["encoder"]["block_0"]["moe"]["w1"]
+    assert tuple(w1.sharding.spec)[0] == "ep"
+
+    def loss_fn(p, mstate, batch):
+        bx, by = batch
+        logits, mutated = model.apply(p, bx, train=True, mutable=["losses"])
+        task = jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(logits, by)
+        )
+        aux = sum(jax.tree_util.tree_leaves(mutated["losses"]))
+        return task + 0.01 * aux, mstate
+
+    step = make_train_step(
+        loss_fn,
+        optimizer,
+        mesh=mesh,
+        state_sharding=shardings,
+        batch_spec=P("dp"),
+        donate=False,
+    )
+    rng = np.random.default_rng(5)
+    batch = shard_batch(
+        (
+            rng.integers(0, 64, size=(8, 16)).astype(np.int32),
+            rng.integers(0, 64, size=(8, 16)).astype(np.int32),
+        ),
+        mesh,
+        spec=P("dp"),
+    )
+    losses = []
+    for _ in range(3):
+        state, loss = step(state, batch)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], f"loss should drop: {losses}"
+    # Layout preserved across steps.
+    w1 = state.params["params"]["encoder"]["block_0"]["moe"]["w1"]
+    assert tuple(w1.sharding.spec)[0] == "ep"
