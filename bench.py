@@ -59,29 +59,56 @@ def _steps_per_sec(step, state, data, warmup: int, steps: int) -> float:
     return steps / (time.perf_counter() - t0)
 
 
-def _bench_resnet50():  # pragma: no cover - requires accelerator time
+def _bench_workload(
+    *,
+    make_model_batch,
+    stateful: bool,
+    metric_name: str,
+    unit: str,
+    steps: int,
+    ndigits: int,
+):
+    """Shared harness: synthetic batch → compiled DP train step → per-chip
+    throughput. ``make_model_batch(n_dev)`` returns
+    ``(model, x, y, loss_fn_factory, optimizer)`` where ``loss_fn_factory``
+    builds the ``(params, model_state, batch)`` loss for that model."""
     import jax
     import jax.numpy as jnp
-    import optax
 
     import fluxmpi_tpu as fm
-    from fluxmpi_tpu.models import ResNet50
     from fluxmpi_tpu.parallel import TrainState, make_train_step
     from fluxmpi_tpu.parallel.train import replicate, shard_batch
 
     mesh = fm.init()
     n_dev = fm.total_workers()
-    per_chip_batch = 64
-    batch = per_chip_batch * n_dev
-    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    model, x, y, loss_fn, optimizer = make_model_batch(n_dev)
 
-    x = jnp.ones((batch, 224, 224, 3), jnp.bfloat16)
-    y = jnp.zeros((batch,), jnp.int32)
-    variables = model.init(jax.random.PRNGKey(0), x[:2], train=False)
-    params = variables["params"]
-    batch_stats = variables.get("batch_stats")
+    if stateful:
+        variables = model.init(jax.random.PRNGKey(0), x[:2], train=False)
+        params = variables["params"]
+        model_state = variables.get("batch_stats")
+    else:
+        params = model.init(jax.random.PRNGKey(0), x[:2])
+        model_state = None
 
-    optimizer = optax.sgd(0.1, momentum=0.9)
+    step = make_train_step(loss_fn, optimizer, mesh=mesh, style="auto")
+    state = replicate(TrainState.create(params, optimizer, model_state), mesh)
+    data = shard_batch((x, y), mesh)
+
+    rate = _steps_per_sec(step, state, data, warmup=3, steps=steps)
+    batch = int(x.shape[0])
+    return {
+        "metric": metric_name,
+        "value": round(batch * rate / n_dev, ndigits),
+        "unit": unit,
+        "vs_baseline": 1.0,
+    }
+
+
+def _bn_loss(model):
+    """Cross-entropy loss for BatchNorm-stateful image classifiers."""
+    import jax.numpy as jnp
+    import optax
 
     def loss_fn(p, mstate, b):
         bx, by = b
@@ -96,103 +123,82 @@ def _bench_resnet50():  # pragma: no cover - requires accelerator time
         ).mean()
         return loss, updates["batch_stats"]
 
-    step = make_train_step(loss_fn, optimizer, mesh=mesh, style="auto")
-    state = replicate(TrainState.create(params, optimizer, batch_stats), mesh)
-    data = shard_batch((x, y), mesh)
+    return loss_fn
 
-    rate = _steps_per_sec(step, state, data, warmup=3, steps=20)
-    return {
-        "metric": "resnet50_images_per_sec_per_chip",
-        "value": round(batch * rate / n_dev, 2),
-        "unit": "images/sec/chip",
-        "vs_baseline": 1.0,
-    }
+
+def _bench_resnet50():  # pragma: no cover - requires accelerator time
+    import jax.numpy as jnp
+    import optax
+
+    def make(n_dev):
+        from fluxmpi_tpu.models import ResNet50
+
+        model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+        batch = 64 * n_dev
+        x = jnp.ones((batch, 224, 224, 3), jnp.bfloat16)
+        y = jnp.zeros((batch,), jnp.int32)
+        return model, x, y, _bn_loss(model), optax.sgd(0.1, momentum=0.9)
+
+    return _bench_workload(
+        make_model_batch=make,
+        stateful=True,
+        metric_name="resnet50_images_per_sec_per_chip",
+        unit="images/sec/chip",
+        steps=20,
+        ndigits=2,
+    )
 
 
 def _bench_cnn():
-    import jax
     import jax.numpy as jnp
     import optax
 
-    import fluxmpi_tpu as fm
-    from fluxmpi_tpu.models import CNN
-    from fluxmpi_tpu.parallel import TrainState, make_train_step
-    from fluxmpi_tpu.parallel.train import replicate, shard_batch
+    def make(n_dev):
+        from fluxmpi_tpu.models import CNN
 
-    mesh = fm.init()
-    n_dev = fm.total_workers()
-    batch = 256 * n_dev
-    model = CNN(num_classes=10)
+        model = CNN(num_classes=10)
+        batch = 256 * n_dev
+        x = jnp.ones((batch, 32, 32, 3), jnp.float32)
+        y = jnp.zeros((batch,), jnp.int32)
+        return model, x, y, _bn_loss(model), optax.sgd(0.1, momentum=0.9)
 
-    x = jnp.ones((batch, 32, 32, 3), jnp.float32)
-    y = jnp.zeros((batch,), jnp.int32)
-    variables = model.init(jax.random.PRNGKey(0), x[:2], train=False)
-    params = variables["params"]
-    batch_stats = variables.get("batch_stats")
-
-    optimizer = optax.sgd(0.1, momentum=0.9)
-
-    def loss_fn(p, mstate, b):
-        bx, by = b
-        logits, updates = model.apply(
-            {"params": p, "batch_stats": mstate},
-            bx,
-            train=True,
-            mutable=["batch_stats"],
-        )
-        loss = optax.softmax_cross_entropy_with_integer_labels(logits, by).mean()
-        return loss, updates["batch_stats"]
-
-    step = make_train_step(loss_fn, optimizer, mesh=mesh, style="auto")
-    state = replicate(TrainState.create(params, optimizer, batch_stats), mesh)
-    data = shard_batch((x, y), mesh)
-
-    rate = _steps_per_sec(step, state, data, warmup=3, steps=30)
-    return {
-        "metric": "cifar_cnn_images_per_sec_per_chip",
-        "value": round(batch * rate / n_dev, 1),
-        "unit": "images/sec/chip",
-        "vs_baseline": 1.0,
-    }
+    return _bench_workload(
+        make_model_batch=make,
+        stateful=True,
+        metric_name="cifar_cnn_images_per_sec_per_chip",
+        unit="images/sec/chip",
+        steps=30,
+        ndigits=1,
+    )
 
 
 def _bench_mlp():
-    import jax
     import jax.numpy as jnp
     import optax
 
-    import fluxmpi_tpu as fm
-    from fluxmpi_tpu.models import MLP
-    from fluxmpi_tpu.parallel import TrainState, make_train_step
-    from fluxmpi_tpu.parallel.train import replicate, shard_batch
+    def make(n_dev):
+        from fluxmpi_tpu.models import MLP
 
-    mesh = fm.init()
-    n_dev = fm.total_workers()
-    batch = 8192 * n_dev
-    model = MLP(features=(256, 256, 256, 1))
+        model = MLP(features=(256, 256, 256, 1))
+        batch = 8192 * n_dev
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.uniform(-2, 2, size=(batch, 1)).astype(np.float32))
+        y = x**2
 
-    rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.uniform(-2, 2, size=(batch, 1)).astype(np.float32))
-    y = x**2
+        def loss_fn(p, mstate, b):
+            bx, by = b
+            return jnp.mean((model.apply(p, bx) - by) ** 2), mstate
 
-    params = model.init(jax.random.PRNGKey(0), x[:2])
-    optimizer = optax.adam(1e-3)
+        return model, x, y, loss_fn, optax.adam(1e-3)
 
-    def loss_fn(p, mstate, b):
-        bx, by = b
-        return jnp.mean((model.apply(p, bx) - by) ** 2), mstate
-
-    step = make_train_step(loss_fn, optimizer, mesh=mesh, style="auto")
-    state = replicate(TrainState.create(params, optimizer), mesh)
-    data = shard_batch((x, y), mesh)
-
-    rate = _steps_per_sec(step, state, data, warmup=3, steps=50)
-    return {
-        "metric": "mlp_quickstart_samples_per_sec_per_chip",
-        "value": round(batch * rate / n_dev, 1),
-        "unit": "samples/sec/chip",
-        "vs_baseline": 1.0,
-    }
+    return _bench_workload(
+        make_model_batch=make,
+        stateful=False,
+        metric_name="mlp_quickstart_samples_per_sec_per_chip",
+        unit="samples/sec/chip",
+        steps=50,
+        ndigits=1,
+    )
 
 
 def _run_child(config: str, timeout: float) -> dict | None:

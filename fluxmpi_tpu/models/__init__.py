@@ -10,6 +10,7 @@
 from .mlp import MLP  # noqa: F401
 from .cnn import CNN  # noqa: F401
 from .moe import (  # noqa: F401
+    MoEEncoder,
     MoEEncoderBlock,
     MoEMLP,
     MoETransformerLM,

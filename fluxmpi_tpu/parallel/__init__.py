@@ -21,4 +21,8 @@ from .sharding import (  # noqa: F401
     transformer_tp_rules,
     tree_partition_specs,
 )
-from .train import TrainState, make_train_step  # noqa: F401
+from .train import (  # noqa: F401
+    TrainState,
+    make_eval_step,
+    make_train_step,
+)

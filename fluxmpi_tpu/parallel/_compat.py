@@ -1,0 +1,29 @@
+"""jax version-compat shims shared by the parallel modules."""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+__all__ = ["shard_map", "shard_map_unchecked"]
+
+
+def shard_map_unchecked(body, mesh, in_specs, out_specs):
+    """``shard_map`` with the replication checker off (its auto-psum on
+    cotangents of replicated inputs would double-count explicit collectives
+    in the body). Newer jax spells the flag ``check_vma``, older ``check_rep``.
+    """
+    try:
+        return shard_map(
+            body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    except TypeError:  # pragma: no cover - older jax spells it check_rep
+        return shard_map(
+            body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )

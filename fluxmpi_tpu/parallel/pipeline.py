@@ -17,8 +17,9 @@ more named axis. The formulation is TPU-idiomatic SPMD:
   permute), so the same schedule serves forward and backward — wrap the
   loss in :func:`jax.grad` as usual.
 
-The inter-stage activation must have a fixed shape: ``stage_fn(params, x)
--> y`` with ``y.shape == x.shape``.
+The inter-stage activation must be uniform: ``stage_fn(params, x) -> y``
+with ``y.shape == x.shape`` AND ``y.dtype == x.dtype`` (the activation is
+the carry of the scan; a clear error is raised at trace time otherwise).
 """
 
 from __future__ import annotations
@@ -30,11 +31,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .. import config
-
-try:
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map  # type: ignore
+from ._compat import shard_map_unchecked
 
 __all__ = ["pipeline_apply", "make_pipeline_fn", "stack_stage_params", "pipeline_rules"]
 
@@ -95,6 +92,18 @@ def pipeline_apply(
     mb = batch // n_microbatches
     x_mb = x.reshape(n_microbatches, mb, *x.shape[1:])
 
+    out_aval = jax.eval_shape(
+        lambda p, a: stage_fn(p, a),
+        params_local,
+        jax.ShapeDtypeStruct((mb, *x.shape[1:]), x.dtype),
+    )
+    if out_aval.shape != (mb, *x.shape[1:]) or out_aval.dtype != x.dtype:
+        raise ValueError(
+            f"stage_fn must preserve the activation shape and dtype: got "
+            f"{out_aval.shape}/{out_aval.dtype} for input "
+            f"{(mb, *x.shape[1:])}/{x.dtype}"
+        )
+
     n_ticks = n_microbatches + n_stages - 1
     fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
 
@@ -154,20 +163,7 @@ def make_pipeline_fn(
         )
 
     param_specs = P(axis_name)  # leading stage dim; rest replicated
-    try:
-        mapped = shard_map(
-            body,
-            mesh=mesh,
-            in_specs=(param_specs, P()),
-            out_specs=P(),
-            check_vma=False,
-        )
-    except TypeError:  # pragma: no cover - older jax spells it check_rep
-        mapped = shard_map(
-            body,
-            mesh=mesh,
-            in_specs=(param_specs, P()),
-            out_specs=P(),
-            check_rep=False,
-        )
+    mapped = shard_map_unchecked(
+        body, mesh, in_specs=(param_specs, P()), out_specs=P()
+    )
     return jax.jit(mapped)

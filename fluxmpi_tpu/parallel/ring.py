@@ -24,11 +24,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import config
-
-try:
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+from ._compat import shard_map_unchecked
 
 __all__ = ["ring_attention", "make_ring_attention", "ring_attention_fn"]
 
@@ -165,12 +161,8 @@ def make_ring_attention(
     def body(q, k, v):
         return ring_attention(q, k, v, axis_name=sp, causal=causal)
 
-    mapped = shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        check_vma=False,
+    mapped = shard_map_unchecked(
+        body, mesh, in_specs=(spec, spec, spec), out_specs=spec
     )
     jitted = jax.jit(mapped)
 

@@ -38,13 +38,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import config
 from ..runtime import global_mesh
+from ._compat import shard_map_unchecked
 
-try:
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map  # type: ignore
-
-__all__ = ["TrainState", "make_train_step", "replicate", "shard_batch"]
+__all__ = [
+    "TrainState",
+    "make_train_step",
+    "make_eval_step",
+    "replicate",
+    "shard_batch",
+]
 
 
 class TrainState(flax.struct.PyTreeNode):
@@ -113,6 +115,8 @@ def make_train_step(
     donate: bool | None = None,
     state_sharding: Any | None = None,
     batch_spec: P | None = None,
+    remat: bool = False,
+    grad_accum_steps: int = 1,
 ) -> Callable[[TrainState, Any], tuple[TrainState, jax.Array]]:
     """Build a compiled data-parallel train step.
 
@@ -145,6 +149,14 @@ def make_train_step(
         ``P(axis_name)`` — batch dim over the data-parallel axis). Use e.g.
         ``P("dp", "sp")`` to also shard the sequence dimension.
         ``style="auto"`` only.
+      remat: rematerialize the forward pass during the backward
+        (``jax.checkpoint`` on the loss) — trades FLOPs for HBM so larger
+        per-chip batches / longer sequences fit.
+      grad_accum_steps: split each batch into this many microbatches and
+        accumulate (mean) gradients over a ``lax.scan`` before the single
+        optimizer update — large effective batches without the HBM. The
+        leading batch dim of every batch leaf must be divisible by it.
+        ``style="auto"`` only.
 
     Returns:
       ``step(state, batch) -> (new_state, loss)`` — compiled, collective
@@ -159,6 +171,8 @@ def make_train_step(
     if grad_reduce not in ("mean", "sum", None):
         raise ValueError("grad_reduce must be 'mean', 'sum', or None")
 
+    if remat:
+        loss_fn = jax.checkpoint(loss_fn)
     grad_and_aux = jax.value_and_grad(loss_fn, has_aux=True)
 
     def _apply_update(ts: TrainState, grads, loss, new_mstate):
@@ -174,13 +188,50 @@ def make_train_step(
             loss,
         )
 
+    if grad_accum_steps < 1:
+        raise ValueError("grad_accum_steps must be >= 1")
+    if grad_accum_steps > 1 and style != "auto":
+        raise ValueError("grad_accum_steps requires style='auto'")
+
     if style == "auto":
 
-        def step(ts: TrainState, batch):
-            (loss, new_mstate), grads = grad_and_aux(
-                ts.params, ts.model_state, batch
-            )
-            return _apply_update(ts, grads, loss, new_mstate)
+        if grad_accum_steps == 1:
+
+            def step(ts: TrainState, batch):
+                (loss, new_mstate), grads = grad_and_aux(
+                    ts.params, ts.model_state, batch
+                )
+                return _apply_update(ts, grads, loss, new_mstate)
+
+        else:
+
+            def step(ts: TrainState, batch):
+                k = grad_accum_steps
+
+                def to_micro(x):
+                    if x.shape[0] % k:
+                        raise ValueError(
+                            f"batch dim {x.shape[0]} not divisible by "
+                            f"grad_accum_steps {k}"
+                        )
+                    return x.reshape(k, x.shape[0] // k, *x.shape[1:])
+
+                micro = jax.tree_util.tree_map(to_micro, batch)
+                zeros = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros_like(p), ts.params
+                )
+
+                def body(carry, mb):
+                    acc_g, acc_l, mstate = carry
+                    (loss, new_ms), g = grad_and_aux(ts.params, mstate, mb)
+                    acc_g = jax.tree_util.tree_map(jnp.add, acc_g, g)
+                    return (acc_g, acc_l + loss, new_ms), None
+
+                (g, l, ms), _ = jax.lax.scan(
+                    body, (zeros, jnp.zeros(()), ts.model_state), micro
+                )
+                grads = jax.tree_util.tree_map(lambda x: x / k, g)
+                return _apply_update(ts, grads, l / k, ms)
 
         replicated = NamedSharding(mesh, P())
         state_in = replicated if state_sharding is None else state_sharding
@@ -223,20 +274,46 @@ def make_train_step(
             )
         return _apply_update(ts, grads, loss, new_mstate)
 
-    try:
-        mapped = shard_map(
-            step_body,
-            mesh=mesh,
-            in_specs=(P(), P(name)),
-            out_specs=(P(), P()),
-            check_vma=False,
-        )
-    except TypeError:  # pragma: no cover - older jax spells it check_rep
-        mapped = shard_map(
-            step_body,
-            mesh=mesh,
-            in_specs=(P(), P(name)),
-            out_specs=(P(), P()),
-            check_rep=False,
-        )
+    mapped = shard_map_unchecked(
+        step_body, mesh, in_specs=(P(), P(name)), out_specs=(P(), P())
+    )
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+def make_eval_step(
+    metric_fn: Callable[[Any, Any, Any], Any],
+    *,
+    mesh: Mesh | None = None,
+    axis_name: str | None = None,
+    state_sharding: Any | None = None,
+    batch_spec: P | None = None,
+) -> Callable[[TrainState, Any], Any]:
+    """Build a compiled evaluation step: ``eval_step(state, batch) ->
+    metrics``.
+
+    ``metric_fn(params, model_state, batch)`` returns any pytree of metrics;
+    reductions written over the global batch (``jnp.mean``/``sum``) are
+    partitioned by XLA the same way the train step's loss is, so the returned
+    metrics are already globally correct — no separate collective pass
+    (the user-land eval loops of the reference's examples get the same
+    treatment as training here).
+
+    ``state_sharding`` / ``batch_spec`` mirror :func:`make_train_step` so an
+    FSDP/TP-sharded :class:`TrainState` evaluates in its training layout.
+    """
+    mesh = mesh or global_mesh()
+    name = axis_name or config.DP_AXIS_NAME
+
+    def step(ts: TrainState, batch):
+        return metric_fn(ts.params, ts.model_state, batch)
+
+    replicated = NamedSharding(mesh, P())
+    state_in = replicated if state_sharding is None else state_sharding
+    batch_sharding = NamedSharding(
+        mesh, P(name) if batch_spec is None else batch_spec
+    )
+    return jax.jit(
+        step,
+        in_shardings=(state_in, batch_sharding),
+        out_shardings=replicated,
+    )

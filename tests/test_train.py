@@ -156,3 +156,86 @@ def test_invalid_style_rejected(world):
         make_train_step(
             lambda p, s, b: (0.0, s), optax.sgd(0.1), grad_reduce="median"
         )
+
+
+def test_remat_matches_plain(world):
+    """jax.checkpoint rematerialization must not change the math."""
+    import optax as _optax
+
+    from fluxmpi_tpu.parallel import make_train_step
+    from fluxmpi_tpu.parallel.train import replicate, shard_batch
+
+    model, params, optimizer, state, loss_fn, batch = _setup(world)
+    plain = make_train_step(loss_fn, optimizer, style="auto", donate=False)
+    remat = make_train_step(
+        loss_fn, optimizer, style="auto", donate=False, remat=True
+    )
+    s1, l1 = plain(replicate(state), shard_batch(batch))
+    s2, l2 = remat(replicate(state), shard_batch(batch))
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        ),
+        s1.params,
+        s2.params,
+    )
+
+
+def test_grad_accum_matches_full_batch(world):
+    """K accumulation microbatches == one full-batch step (same mean-loss
+    semantics, single optimizer update)."""
+    from fluxmpi_tpu.parallel import make_train_step
+    from fluxmpi_tpu.parallel.train import replicate, shard_batch
+
+    model, params, optimizer, state, loss_fn, batch = _setup(world)
+    full = make_train_step(loss_fn, optimizer, style="auto", donate=False)
+    accum = make_train_step(
+        loss_fn, optimizer, style="auto", donate=False, grad_accum_steps=4
+    )
+    s1, l1 = full(replicate(state), shard_batch(batch))
+    s2, l2 = accum(replicate(state), shard_batch(batch))
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        ),
+        s1.params,
+        s2.params,
+    )
+    assert int(s2.step) == 1  # one update, not four
+
+
+def test_grad_accum_divisibility_error(world):
+    from fluxmpi_tpu.parallel import make_train_step
+    from fluxmpi_tpu.parallel.train import replicate, shard_batch
+
+    model, params, optimizer, state, loss_fn, batch = _setup(world)
+    step = make_train_step(
+        loss_fn, optimizer, style="auto", donate=False, grad_accum_steps=5
+    )
+    with pytest.raises(ValueError, match="not divisible"):
+        step(replicate(state), shard_batch(batch))
+
+
+def test_eval_step(world):
+    from fluxmpi_tpu.parallel import make_eval_step
+    from fluxmpi_tpu.parallel.train import replicate, shard_batch
+
+    model, params, optimizer, state, loss_fn, batch = _setup(world)
+
+    def metric_fn(p, mstate, b):
+        x, y = b
+        pred = model.apply(p, x)
+        return {"mse": jnp.mean((pred - y) ** 2), "mae": jnp.mean(jnp.abs(pred - y))}
+
+    ev = make_eval_step(metric_fn)
+    metrics = ev(replicate(state), shard_batch(batch))
+    x, y = batch
+    pred = model.apply(params, x)
+    np.testing.assert_allclose(
+        float(metrics["mse"]), float(jnp.mean((pred - y) ** 2)), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        float(metrics["mae"]), float(jnp.mean(jnp.abs(pred - y))), rtol=1e-5
+    )
