@@ -1,3 +1,7 @@
 """Pallas TPU kernels for hot ops (with interpret-mode CPU fallback)."""
 
-from .flash_attention import flash_attention  # noqa: F401
+from .flash_attention import (  # noqa: F401
+    flash_attention,
+    flash_attention_fn,
+    flash_attention_with_lse,
+)

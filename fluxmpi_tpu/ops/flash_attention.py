@@ -1,14 +1,21 @@
-"""Flash attention as a Pallas TPU kernel.
+"""Flash attention as Pallas TPU kernels — forward AND backward.
 
 The single-chip hot op under :mod:`fluxmpi_tpu.parallel.ring`'s ring layer:
-ring attention moves K/V blocks *between* chips over ICI; this kernel makes
+ring attention moves K/V blocks *between* chips over ICI; these kernels make
 the *on-chip* block computation memory-optimal — Q/K/V tiles stream
 HBM→VMEM, scores never materialize in HBM, and the online-softmax
 accumulators live in VMEM scratch across the K-block grid dimension.
 
+Differentiation is a ``jax.custom_vjp`` over ``(out, lse)`` with the
+standard recompute-based two-pass backward (one kernel for dQ, one for
+dK/dV); exposing the logsumexp *and* honoring its cotangent is what lets
+ring attention merge per-ring-step flash results in plain JAX and stay
+exactly differentiable — the lse cotangent folds into the dS term as
+``ds = p * (dp - delta + dlse)``.
+
 Block sizes default to MXU/VPU-friendly shapes (128 lanes; f32 accumulation
-regardless of input dtype). On non-TPU backends the kernel runs in Pallas
-interpret mode, which is how the CPU test suite exercises it.
+regardless of input dtype). On non-TPU backends the kernels run in Pallas
+interpret mode, which is how the CPU test suite exercises them.
 """
 
 from __future__ import annotations
@@ -19,7 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_with_lse", "flash_attention_fn"]
 
 _NEG_INF = -1e30
 
@@ -29,6 +36,7 @@ def _flash_kernel(
     k_ref,
     v_ref,
     o_ref,
+    lse_ref,
     m_scratch,
     l_scratch,
     acc_scratch,
@@ -101,6 +109,337 @@ def _flash_kernel(
         l_final = l_scratch[...][:, :1]
         l_safe = jnp.where(l_final == 0.0, 1.0, l_final)
         o_ref[0] = (acc_scratch[...] / l_safe).astype(o_ref.dtype)
+        # Rows with no attendable keys get lse = m = -1e30 (≈ -inf), which
+        # merges as a zero-weight block in ring accumulation.
+        lse_ref[0] = m_scratch[...][:, 0] + jnp.log(l_safe[:, 0])
+
+
+def _flash_bwd_dq_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    dterm_ref,
+    dq_ref,
+    dq_scratch,
+    *,
+    sm_scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    num_k_blocks: int,
+):
+    """dQ pass: for each Q block, sweep K/V blocks (innermost grid dim),
+    recompute probabilities from the saved lse, accumulate
+    ``dq += (p ∘ (dp - dterm)) @ K · scale`` in VMEM scratch."""
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_scratch[...] = jnp.zeros_like(dq_scratch)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # [block_q, d]
+        k = k_ref[0].astype(jnp.float32)  # [block_k, d]
+        v = v_ref[0].astype(jnp.float32)  # [block_k, d]
+        do = do_ref[0].astype(jnp.float32)  # [block_q, d]
+        lse = lse_ref[0]  # [block_q]
+        dterm = dterm_ref[0]  # [block_q] — delta - dlse
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale  # [block_q, block_k]
+        p = jnp.exp(s - lse[:, None])  # normalized probabilities
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [block_q, block_k]
+        ds = p * (dp - dterm[:, None]) * sm_scale
+        dq_scratch[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        @pl.when(kj * block_k < (qi + 1) * block_q)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(kj == num_k_blocks - 1)
+    def _finish():
+        dq_ref[0] = dq_scratch[...].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    dterm_ref,
+    dk_ref,
+    dv_ref,
+    dk_scratch,
+    dv_scratch,
+    *,
+    sm_scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    num_q_blocks: int,
+):
+    """dK/dV pass: for each K/V block, sweep Q blocks (innermost grid dim),
+    accumulating ``dv += pᵀ @ dO`` and ``dk += (p ∘ (dp - dterm))ᵀ @ Q ·
+    scale`` in VMEM scratch (transposed forms computed directly to keep the
+    contraction on the MXU)."""
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scratch[...] = jnp.zeros_like(dk_scratch)
+        dv_scratch[...] = jnp.zeros_like(dv_scratch)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # [block_q, d]
+        k = k_ref[0].astype(jnp.float32)  # [block_k, d]
+        v = v_ref[0].astype(jnp.float32)  # [block_k, d]
+        do = do_ref[0].astype(jnp.float32)  # [block_q, d]
+        lse = lse_ref[0]  # [block_q]
+        dterm = dterm_ref[0]  # [block_q]
+
+        s_t = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale  # [block_k, block_q]
+        p_t = jnp.exp(s_t - lse[None, :])
+        if causal:
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 0
+            )
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 1
+            )
+            p_t = jnp.where(q_pos >= k_pos, p_t, 0.0)
+        dv_scratch[...] += jax.lax.dot_general(
+            p_t, do, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [block_k, d]
+        dp_t = jax.lax.dot_general(
+            v, do, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [block_k, block_q]
+        ds_t = p_t * (dp_t - dterm[None, :]) * sm_scale
+        dk_scratch[...] += jax.lax.dot_general(
+            ds_t, q, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        # Skip q-blocks entirely in the past of this k-block (every score
+        # masked).
+        @pl.when((qi + 1) * block_q > kj * block_k)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _finish():
+        dk_ref[0] = dk_scratch[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scratch[...].astype(dv_ref.dtype)
+
+
+def _fold_heads(x):
+    """(b, s, h, d) → (b·h, s, d)."""
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _unfold_heads(x, b, h):
+    bh, s, d = x.shape
+    return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _fwd_pallas(q, k, v, causal, block_q, block_k, interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    sm_scale = 1.0 / (d**0.5)
+    num_k_blocks = sk // block_k
+
+    qr, kr, vr = _fold_heads(q), _fold_heads(k), _fold_heads(v)
+
+    kernel = functools.partial(
+        _flash_kernel,
+        sm_scale=sm_scale,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+        num_k_blocks=num_k_blocks,
+    )
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // block_q, num_k_blocks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, qi, kj: (bh, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qr, kr, vr)
+
+    return _unfold_heads(out, b, h), lse.reshape(b, h, sq)
+
+
+def _bwd_pallas(q, k, v, out, lse, do, dlse, causal, block_q, block_k, interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    sm_scale = 1.0 / (d**0.5)
+    num_q_blocks = sq // block_q
+    num_k_blocks = sk // block_k
+
+    qr, kr, vr = _fold_heads(q), _fold_heads(k), _fold_heads(v)
+    dor = _fold_heads(do.astype(jnp.float32))
+    or_ = _fold_heads(out.astype(jnp.float32))
+    lse_r = lse.reshape(b * h, sq)
+    # delta_r = rowsum(dO ∘ O): the softmax-normalization term of the output
+    # cotangent; the lse cotangent enters the same dS slot with opposite
+    # sign, so one fused [bh, sq] operand serves both paths.
+    delta = jnp.sum(dor * or_, axis=-1)
+    dterm = delta - dlse.reshape(b * h, sq).astype(jnp.float32)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel,
+            sm_scale=sm_scale,
+            causal=causal,
+            block_q=block_q,
+            block_k=block_k,
+            num_k_blocks=num_k_blocks,
+        ),
+        grid=(b * h, num_q_blocks, num_k_blocks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, qi, kj: (bh, qi)),
+            pl.BlockSpec((1, block_q), lambda bh, qi, kj: (bh, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qr, kr, vr, dor, lse_r, dterm)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel,
+            sm_scale=sm_scale,
+            causal=causal,
+            block_q=block_q,
+            block_k=block_k,
+            num_q_blocks=num_q_blocks,
+        ),
+        grid=(b * h, num_k_blocks, num_q_blocks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, kj, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, kj, qi: (bh, kj, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, kj, qi: (bh, kj, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, kj, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, kj, qi: (bh, qi)),
+            pl.BlockSpec((1, block_q), lambda bh, kj, qi: (bh, qi)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, kj, qi: (bh, kj, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, kj, qi: (bh, kj, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qr, kr, vr, dor, lse_r, dterm)
+
+    return (
+        _unfold_heads(dq, b, h),
+        _unfold_heads(dk, b, h),
+        _unfold_heads(dv, b, h),
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _fwd_pallas(q, k, v, causal, block_q, block_k, interpret)
+    return out, lse
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _fwd_pallas(q, k, v, causal, block_q, block_k, interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, cotangents):
+    q, k, v, out, lse = res
+    do, dlse = cotangents
+    return _bwd_pallas(
+        q, k, v, out, lse, do, dlse, causal, block_q, block_k, interpret
+    )
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _prepare(q, k, v, block_q, block_k, interpret):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(
+            f"sequence lengths ({sq}, {sk}) must be divisible by block sizes "
+            f"({block_q}, {block_k})"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return block_q, block_k, interpret
 
 
 @functools.partial(
@@ -121,58 +460,74 @@ def flash_attention(
     Tiles stream through VMEM with online-softmax accumulation; the
     ``[seq, seq]`` score matrix never exists in HBM. Sequence length must
     divide the block sizes (pad upstream). f32 accumulation, output in the
-    input dtype.
+    input dtype. Fully differentiable (Pallas backward kernels).
     """
-    b, sq, h, d = q.shape
-    sk = k.shape[1]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
-    if sq % block_q or sk % block_k:
-        raise ValueError(
-            f"sequence lengths ({sq}, {sk}) must be divisible by block sizes "
-            f"({block_q}, {block_k})"
-        )
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    block_q, block_k, interpret = _prepare(q, k, v, block_q, block_k, interpret)
+    out, _ = _flash(q, k, v, causal, block_q, block_k, interpret)
+    return out
 
-    sm_scale = 1.0 / (d**0.5)
-    num_k_blocks = sk // block_k
 
-    # Fold heads into batch; kernel works on [bh, seq, d].
-    qr = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    kr = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    vr = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def flash_attention_with_lse(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """:func:`flash_attention` that also returns the per-row logsumexp
+    ``lse`` with shape ``(batch, heads, seq)`` — the merge key for combining
+    independently-computed attention blocks (ring attention). Differentiable
+    in both outputs (the lse cotangent folds into the backward's dS term).
+    """
+    block_q, block_k, interpret = _prepare(q, k, v, block_q, block_k, interpret)
+    return _flash(q, k, v, causal, block_q, block_k, interpret)
 
-    from jax.experimental.pallas import tpu as pltpu
 
-    kernel = functools.partial(
-        _flash_kernel,
-        sm_scale=sm_scale,
-        causal=causal,
-        block_q=block_q,
-        block_k=block_k,
-        num_k_blocks=num_k_blocks,
-    )
+def flash_attention_fn(
+    causal: bool = False,
+    *,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+):
+    """An ``attention_fn`` drop-in for ``nn.MultiHeadDotProductAttention``
+    (e.g. ``TransformerLM(attention_fn=flash_attention_fn(causal=True))``).
 
-    out = pl.pallas_call(
-        kernel,
-        grid=(b * h, sq // block_q, num_k_blocks),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, d), jnp.float32),
-        ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
-        ),
-        interpret=interpret,
-    )(qr, kr, vr)
+    Masking must be expressed through ``causal`` — an explicit dense
+    mask/bias defeats the point of never materializing scores. With
+    ``causal=True`` a passed-in mask is assumed to be the standard causal
+    mask (exactly what the kernel computes) and ignored; with
+    ``causal=False`` a mask/bias raises rather than silently attending to
+    masked positions. Attention dropout is unsupported (keep it 0).
+    """
 
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    def fn(query, key, value, bias=None, mask=None, **kwargs):
+        if not causal and (bias is not None or mask is not None):
+            raise ValueError(
+                "flash_attention_fn(causal=False) cannot honor an explicit "
+                "mask/bias (the score matrix never materializes); for causal "
+                "LMs pass flash_attention_fn(causal=True)"
+            )
+        dropout_rate = kwargs.get("dropout_rate", 0.0)
+        if dropout_rate and not kwargs.get("deterministic", True):
+            raise ValueError(
+                "flash_attention_fn does not implement attention dropout; "
+                "set dropout_rate=0 on the attention module"
+            )
+        return flash_attention(
+            query,
+            key,
+            value,
+            causal=causal,
+            block_q=block_q,
+            block_k=block_k,
+            interpret=interpret,
+        ).astype(query.dtype)
+
+    return fn

@@ -57,6 +57,68 @@ def _block_attend(q, k, v, o, m, l, mask):
     return o_new, m_new, l_new
 
 
+def _ring_flash(q, k, v, *, name: str, causal: bool, n: int, idx):
+    """Ring accumulation with the Pallas flash kernel as the local block
+    attend (:func:`fluxmpi_tpu.ops.flash_attention_with_lse`).
+
+    Each resident K/V block is attended by the flash kernel, which returns a
+    *normalized* block output plus its logsumexp; blocks merge in plain JAX
+    via the standard lse-weighted combine. The kernel's custom VJP honors
+    the lse cotangent, so the whole ring differentiates exactly.
+    """
+    from ..ops.flash_attention import flash_attention_with_lse
+
+    b, sq, h, d = q.shape
+    o = jnp.zeros((b, sq, h, d), dtype=jnp.float32)
+    lse = jnp.full((b, sq, h), _NEG_INF, dtype=jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def merge(o, lse, o_blk, lse_blk):
+        # lse_blk arrives as (b, h, sq) from the kernel.
+        lse_blk = jnp.moveaxis(lse_blk, 1, -1)
+        lse_new = jnp.logaddexp(lse, lse_blk)
+        w_prev = jnp.exp(lse - lse_new)[..., None]
+        w_blk = jnp.exp(lse_blk - lse_new)[..., None]
+        return o * w_prev + o_blk.astype(jnp.float32) * w_blk, lse_new
+
+    def body(s, carry):
+        o, lse, k_blk, v_blk = carry
+        # After s rotations, the resident block originated on ring position
+        # (idx - s) mod n.
+        src = (idx - s) % n
+
+        def full_blk(_):
+            return flash_attention_with_lse(q, k_blk, v_blk, causal=False)
+
+        if causal:
+            def diag_blk(_):
+                # Same ring position: global offsets cancel, local causal.
+                return flash_attention_with_lse(q, k_blk, v_blk, causal=True)
+
+            def skip_blk(_):
+                return (
+                    jnp.zeros((b, sq, h, d), q.dtype),
+                    jnp.full((b, h, sq), _NEG_INF, jnp.float32),
+                )
+
+            o_blk, lse_blk = jax.lax.cond(
+                src > idx,
+                skip_blk,
+                lambda _: jax.lax.cond(src == idx, diag_blk, full_blk, None),
+                None,
+            )
+        else:
+            o_blk, lse_blk = full_blk(None)
+
+        o2, lse2 = merge(o, lse, o_blk, lse_blk)
+        k_next = jax.lax.ppermute(k_blk, name, perm)
+        v_next = jax.lax.ppermute(v_blk, name, perm)
+        return o2, lse2, k_next, v_next
+
+    o, lse, _, _ = jax.lax.fori_loop(0, n, body, (o, lse, k, v))
+    return o.astype(q.dtype)
+
+
 def ring_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -64,6 +126,7 @@ def ring_attention(
     *,
     axis_name: str | None = None,
     causal: bool = False,
+    use_flash: bool = False,
 ) -> jnp.ndarray:
     """Blockwise ring attention; call inside ``shard_map`` with the sequence
     dimension of q/k/v sharded over ``axis_name``.
@@ -72,11 +135,19 @@ def ring_attention(
     currently resident, then rotates K/V to the next ring neighbor. With
     ``causal=True``, blocks strictly in the future are skipped via a zero
     mask (compiled as a select — no dynamic control flow).
+
+    ``use_flash=True`` swaps the dense local block attend for the Pallas
+    flash kernel (memory-optimal on-chip: the [sq, sk] score block never
+    leaves VMEM); local sequence lengths must then divide the kernel's block
+    sizes.
     """
     name = axis_name or config.SP_AXIS_NAME
     n = jax.lax.axis_size(name)
     idx = jax.lax.axis_index(name)
     b, sq, h, d = q.shape
+
+    if use_flash:
+        return _ring_flash(q, k, v, name=name, causal=causal, n=n, idx=idx)
 
     o = jnp.zeros_like(q, dtype=jnp.float32)
     m = jnp.full((b, sq, h), _NEG_INF, dtype=jnp.float32)
@@ -109,7 +180,11 @@ def ring_attention(
     return (o / l[..., None]).astype(q.dtype)
 
 
-def ring_attention_fn(axis_name: str | None = None, causal: bool = False):
+def ring_attention_fn(
+    axis_name: str | None = None,
+    causal: bool = False,
+    use_flash: bool = False,
+):
     """An ``attention_fn`` drop-in for ``nn.MultiHeadDotProductAttention``.
 
     Use on a :class:`fluxmpi_tpu.models.TransformerEncoder` applied inside a
@@ -132,7 +207,8 @@ def ring_attention_fn(axis_name: str | None = None, causal: bool = False):
                 "pass causal=True instead of an explicit mask/bias"
             )
         return ring_attention(
-            query, key, value, axis_name=axis_name, causal=causal
+            query, key, value, axis_name=axis_name, causal=causal,
+            use_flash=use_flash,
         )
 
     return fn
@@ -144,6 +220,7 @@ def make_ring_attention(
     axis_name: str | None = None,
     causal: bool = False,
     batch_axis_name: str | None = None,
+    use_flash: bool = False,
 ):
     """Wrap :func:`ring_attention` for eager use on mesh-sharded arrays.
 
@@ -159,7 +236,9 @@ def make_ring_attention(
     spec = P(dp, sp)
 
     def body(q, k, v):
-        return ring_attention(q, k, v, axis_name=sp, causal=causal)
+        return ring_attention(
+            q, k, v, axis_name=sp, causal=causal, use_flash=use_flash
+        )
 
     mapped = shard_map_unchecked(
         body, mesh, in_specs=(spec, spec, spec), out_specs=spec

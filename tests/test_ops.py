@@ -76,3 +76,106 @@ def test_flash_bad_blocks_rejected(world):
     q, k, v = _qkv(s=48, seed=4)
     with pytest.raises(ValueError):
         flash_attention(q, k, v, block_q=32, block_k=32)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_grad_matches_dense(world, causal):
+    # The Pallas backward kernels (dq + dk/dv) against autodiff through the
+    # dense oracle (VERDICT r1 next #3).
+    from fluxmpi_tpu.ops import flash_attention
+
+    q, k, v = _qkv(seed=5)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(jnp.sin(flash_attention(q, k, v, causal=causal,
+                                               block_q=32, block_k=32)))
+
+    def loss_dense(q, k, v):
+        return jnp.sum(jnp.sin(_dense(q, k, v, causal=causal)))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_flash_lse_and_its_gradient(world):
+    # flash_attention_with_lse: the lse output matches dense logsumexp and
+    # its cotangent is honored (the merge key ring attention relies on).
+    from fluxmpi_tpu.ops import flash_attention_with_lse
+
+    q, k, v = _qkv(seed=6)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    lse_dense = jax.scipy.special.logsumexp(s, axis=-1)  # [b, h, q]
+
+    out, lse = flash_attention_with_lse(q, k, v, block_q=32, block_k=32)
+    np.testing.assert_allclose(
+        np.asarray(lse), np.asarray(lse_dense), atol=1e-5
+    )
+
+    def loss_flash(q, k, v):
+        out, lse = flash_attention_with_lse(q, k, v, block_q=32, block_k=32)
+        return jnp.sum(jnp.cos(lse)) + jnp.sum(out**2)
+
+    def loss_dense(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        lse = jax.scipy.special.logsumexp(s, axis=-1)
+        return jnp.sum(jnp.cos(lse)) + jnp.sum(_dense(q, k, v) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_transformer_trains_through_flash_attention(world):
+    # A TransformerLM whose attention is the Pallas kernel end-to-end: the
+    # compiled DP train step runs and the flash model's gradients match the
+    # dense-attention model's (same params, same batch).
+    import optax
+
+    import fluxmpi_tpu as fm
+    from fluxmpi_tpu.models import TransformerLM
+    from fluxmpi_tpu.ops import flash_attention_fn
+    from fluxmpi_tpu.parallel import TrainState, make_train_step
+    from fluxmpi_tpu.parallel.train import replicate, shard_batch
+
+    mesh = fm.global_mesh()
+    kwargs = dict(vocab_size=64, max_len=32, num_layers=1, d_model=32,
+                  num_heads=2, d_ff=64)
+    flash_model = TransformerLM(
+        attention_fn=flash_attention_fn(causal=True), **kwargs
+    )
+    dense_model = TransformerLM(**kwargs)
+
+    rng = np.random.default_rng(7)
+    tokens = jnp.asarray(rng.integers(0, 64, size=(16, 32)).astype(np.int32))
+    params = dense_model.init(jax.random.PRNGKey(0), tokens[:2], train=False)
+
+    def make_loss(model):
+        def loss_fn(p, mstate, batch):
+            logits = model.apply(p, batch, train=True)
+            targets = jnp.roll(batch, -1, axis=1)
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], targets[:, :-1]
+            ).mean()
+            return loss, mstate
+
+        return loss_fn
+
+    gf = jax.grad(lambda p: make_loss(flash_model)(p, None, tokens)[0])(params)
+    gd = jax.grad(lambda p: make_loss(dense_model)(p, None, tokens)[0])(params)
+    flat_f = jax.tree_util.tree_leaves(gf)
+    flat_d = jax.tree_util.tree_leaves(gd)
+    for a, b in zip(flat_f, flat_d):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+    step = make_train_step(
+        make_loss(flash_model), optax.adam(1e-3), mesh=mesh, style="auto"
+    )
+    state = replicate(TrainState.create(params, optax.adam(1e-3)), mesh)
+    data = shard_batch(tokens, mesh)
+    state, loss0 = step(state, data)
+    state, loss1 = step(state, data)
+    assert np.isfinite(float(loss0)) and float(loss1) < float(loss0)
