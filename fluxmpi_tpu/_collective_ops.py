@@ -1,0 +1,54 @@
+"""Shared in-trace collective lowerings.
+
+One home for the lowering tricks used by both the eager layer
+(:mod:`fluxmpi_tpu.comm`, inside its ``shard_map`` bodies) and the in-jit
+helpers (:mod:`fluxmpi_tpu.parallel.collectives`), so the two layers cannot
+drift: the masked-psum broadcast (O(bytes), no all-gather) and the
+named-op all-reduce including the gather-based ``prod``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["masked_psum_bcast", "allreduce_by_op"]
+
+
+def masked_psum_bcast(x: Any, root: int, axis: str) -> Any:
+    """Broadcast the root member's value across a bound mesh axis as ONE
+    O(bytes) AllReduce: non-root members contribute exact zeros, so the sum
+    is the root's value everywhere — not the O(world × bytes)
+    all-gather+slice lowering. Bools ride through int32 (no AllReduce for
+    pred types)."""
+    idx = jax.lax.axis_index(axis)
+
+    def leaf_bcast(leaf):
+        leaf = jnp.asarray(leaf)
+        as_bool = leaf.dtype == jnp.bool_
+        li = leaf.astype(jnp.int32) if as_bool else leaf
+        out = jax.lax.psum(jnp.where(idx == root, li, jnp.zeros_like(li)), axis)
+        return out.astype(jnp.bool_) if as_bool else out
+
+    return jax.tree_util.tree_map(leaf_bcast, x)
+
+
+def allreduce_by_op(x: Any, op: str, axis: str) -> Any:
+    """All-reduce with a named op across a bound mesh axis. ``sum``, ``max``,
+    ``min``, ``mean`` map to native XLA AllReduce variants; ``prod`` (which
+    XLA has no AllReduce for) lowers to all-gather + local product."""
+    if op == "sum":
+        return jax.lax.psum(x, axis)
+    if op == "max":
+        return jax.lax.pmax(x, axis)
+    if op == "min":
+        return jax.lax.pmin(x, axis)
+    if op == "mean":
+        return jax.lax.pmean(x, axis)
+    if op == "prod":
+        return jax.tree_util.tree_map(
+            lambda leaf: jnp.prod(jax.lax.all_gather(leaf, axis), axis=0), x
+        )
+    raise ValueError(f"unsupported in-trace reduction {op!r}")

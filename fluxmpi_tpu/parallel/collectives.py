@@ -30,28 +30,26 @@ def pmean_tree(tree: Any, axis_name: str | None = None) -> Any:
 
 
 def pallreduce(x: Any, op: str = "sum", axis_name: str | None = None) -> Any:
-    """All-reduce with a named op inside a compiled step."""
+    """All-reduce with a named op inside a compiled step.
+
+    ``prod`` parity with the eager layer (reference
+    test/test_mpi_extensions.jl:9-23 exercises ``*``): XLA has no AllReduce
+    product, so it lowers to all-gather + local product.
+    """
+    from .._collective_ops import allreduce_by_op
+
     name = axis_name or config.DP_AXIS_NAME
-    if op in ("sum", "+"):
-        return jax.lax.psum(x, name)
-    if op in ("mean", "avg"):
-        return jax.lax.pmean(x, name)
-    if op == "max":
-        return jax.lax.pmax(x, name)
-    if op == "min":
-        return jax.lax.pmin(x, name)
-    raise ValueError(f"unsupported in-jit reduction {op!r}")
+    aliases = {"+": "sum", "avg": "mean", "*": "prod", "mul": "prod"}
+    return allreduce_by_op(x, aliases.get(op, op), name)
 
 
 def pbroadcast(x: Any, root: int = 0, axis_name: str | None = None) -> Any:
     """Broadcast the root worker's value across a bound mesh axis (compiled
-    analogue of ``bcast!``, reference src/mpi_extensions.jl:119-133)."""
-    import jax.numpy as jnp
+    analogue of ``bcast!``, reference src/mpi_extensions.jl:119-133).
 
-    name = axis_name or config.DP_AXIS_NAME
+    Lowered as a masked psum — non-root members contribute exact zeros, so
+    one O(bytes) AllReduce delivers the root's value everywhere (no
+    O(world × bytes) all-gather)."""
+    from .._collective_ops import masked_psum_bcast
 
-    def _bcast_leaf(leaf):
-        gathered = jax.lax.all_gather(leaf, name)
-        return jnp.take(gathered, root, axis=0)
-
-    return jax.tree_util.tree_map(_bcast_leaf, x)
+    return masked_psum_bcast(x, root, axis_name or config.DP_AXIS_NAME)

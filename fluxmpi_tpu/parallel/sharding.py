@@ -159,13 +159,24 @@ def transformer_tp_rules(tp_axis: str | None = None) -> Rule:
     )
 
 
-def _validated(spec: P | None, shape: tuple[int, ...], mesh: Mesh) -> P:
+def _validated(
+    spec: P | None, shape: tuple[int, ...], mesh: Mesh, path: str = "<leaf>"
+) -> P:
     """Clamp a rule's spec to what the leaf shape actually supports:
     mismatched rank or non-divisible dims degrade to replicated on that dim
-    rather than failing at compile time."""
+    rather than failing at compile time — loudly, so a misconfigured layout
+    (tp=3 on 4 heads, a typo'd axis) is diagnosable without inspecting
+    ``.sharding`` by hand."""
+    import warnings
+
     if spec is None:
         return P()
     if len(spec) > len(shape):
+        warnings.warn(
+            f"sharding rule for {path!r} has spec {spec} with more dims than "
+            f"the leaf shape {shape}; leaf stays replicated",
+            stacklevel=3,
+        )
         return P()
     out = []
     for d, names in enumerate(tuple(spec) + (None,) * (len(shape) - len(spec))):
@@ -173,11 +184,27 @@ def _validated(spec: P | None, shape: tuple[int, ...], mesh: Mesh) -> P:
             out.append(None)
             continue
         group = (names,) if isinstance(names, str) else tuple(names)
-        if any(n not in mesh.shape for n in group):
+        missing = [n for n in group if n not in mesh.shape]
+        if missing:
+            warnings.warn(
+                f"sharding rule for {path!r} names mesh axis "
+                f"{missing[0]!r} absent from mesh axes "
+                f"{tuple(mesh.axis_names)}; dim {d} stays replicated",
+                stacklevel=3,
+            )
             out.append(None)
             continue
         size = int(np.prod([mesh.shape[n] for n in group]))
-        out.append(names if shape[d] % size == 0 else None)
+        if shape[d] % size:
+            warnings.warn(
+                f"sharding rule for {path!r}: dim {d} of shape {shape} not "
+                f"divisible by axis {names!r} size {size}; dim stays "
+                f"replicated",
+                stacklevel=3,
+            )
+            out.append(None)
+        else:
+            out.append(names)
     return P(*out)
 
 
@@ -188,7 +215,8 @@ def tree_partition_specs(tree: Any, mesh: Mesh, rule: Rule) -> Any:
         shape = tuple(getattr(leaf, "shape", ()) or ())
         if not shape:
             return P()
-        return _validated(rule(_path_str(path), shape), shape, mesh)
+        p = _path_str(path)
+        return _validated(rule(p, shape), shape, mesh, path=p)
 
     return jax.tree_util.tree_map_with_path(leaf_spec, tree)
 

@@ -200,9 +200,15 @@ def test_rule_validation_degrades_to_replicated(world):
     mesh = _mesh(world, {"dp": 8})
     tree = {"w": jnp.ones((6, 4)), "b": jnp.ones((3,))}
     rule = rule_from_table([(r".*", P("dp"))])
-    specs = tree_partition_specs(tree, mesh, rule)
+    with pytest.warns(UserWarning, match="not divisible"):
+        specs = tree_partition_specs(tree, mesh, rule)
     assert all(a is None for a in tuple(specs["w"]))
     assert all(a is None for a in tuple(specs["b"]))
+
+    # A typo'd / absent mesh axis is also loud (ADVICE r1).
+    bad_axis = rule_from_table([(r".*", P("tp"))])
+    with pytest.warns(UserWarning, match="absent from mesh axes"):
+        tree_partition_specs({"w": jnp.ones((8, 4))}, mesh, bad_axis)
 
     tree2 = {"w": jnp.ones((16, 4))}
     specs2 = tree_partition_specs(tree2, mesh, rule)
