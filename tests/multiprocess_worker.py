@@ -84,5 +84,137 @@ for batch in loader:
     count += 1
 assert count == len(loader)
 
+# --- println serialization ordering (reference: src/common.jl:86-92) ---
+# Each rank prints to a shared append-only file at its barrier-gated turn;
+# the parent asserts the lines land in strict rank order.
+ordering_path = os.environ.get("FLUXMPI_TEST_ORDER_FILE")
+if ordering_path:
+    with open(ordering_path, "a", buffering=1) as f:
+        fm.fluxmpi_println(f"ORDER rank={process_id}", file=f)
+
+# --- compiled train step over the process-spanning mesh ---
+import optax
+
+from fluxmpi_tpu.models import MLP
+from fluxmpi_tpu.parallel import TrainState, make_train_step
+from fluxmpi_tpu.parallel.train import replicate
+
+model = MLP(features=(16, 16, 1))
+rng = np.random.default_rng(0)  # same seed → same data on every process
+xs_all = rng.uniform(-2, 2, size=(64, 1)).astype(np.float32)
+ys_all = xs_all**2
+
+params = fm.synchronize(model.init(jax.random.PRNGKey(process_id), xs_all[:2]))
+
+
+def loss_fn(p, mstate, batch):
+    bx, by = batch
+    return jnp.mean((model.apply(p, bx) - by) ** 2), mstate
+
+
+optimizer = optax.adam(1e-2)
+step = make_train_step(loss_fn, optimizer, mesh=mesh, style="auto")
+state = replicate(TrainState.create(params, optimizer), mesh)
+
+train_data = fm.ArrayDataset((xs_all, ys_all))
+train_container = fm.DistributedDataContainer(train_data)
+train_loader = fm.DistributedDataLoader(
+    train_container, global_batch_size=num_processes * 8, mesh=mesh
+)
+losses = []
+for _ in range(3):
+    for batch in train_loader:
+        state, loss = step(state, batch)
+        losses.append(float(loss))
+assert all(np.isfinite(losses)), losses
+assert losses[-1] < losses[0], losses
+# The replicated loss and updated params must agree bit-for-bit across
+# processes (max == min over the world).
+spread = fm.host_allreduce(np.asarray(losses[-1]), op="max") - fm.host_allreduce(
+    np.asarray(losses[-1]), op="min"
+)
+assert float(spread) == 0.0, spread
+w0 = np.asarray(jax.device_get(jax.tree_util.tree_leaves(state.params)[0]))
+w_spread = fm.host_allreduce(w0, op="max") - fm.host_allreduce(w0, op="min")
+np.testing.assert_allclose(w_spread, 0.0)
+
+# --- checkpoint save/restore across processes ---
+ckpt_dir = os.environ.get("FLUXMPI_TEST_CKPT_DIR")
+if ckpt_dir:
+    from fluxmpi_tpu.utils import restore_checkpoint, save_checkpoint
+
+    # Replicated state: lead process writes, restore root-broadcasts.
+    rep_path = os.path.join(ckpt_dir, "replicated")
+    save_checkpoint(rep_path, state)
+    zeros = jax.tree_util.tree_map(
+        lambda x: jnp.zeros_like(x) if isinstance(x, jax.Array) else x, state
+    )
+    zeros = replicate(zeros, mesh)
+    restored = restore_checkpoint(rep_path, zeros)
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(jax.tree_util.tree_leaves(restored.params)[0])),
+        w0,
+    )
+
+    # Sharded (FSDP) state: every process writes/reads only its own shards.
+    from fluxmpi_tpu.parallel import fsdp_rule, shard_tree
+
+    big_params = {
+        "w": jnp.arange(16 * num_processes, dtype=jnp.float32).reshape(
+            num_processes * 4, 4
+        )
+    }
+    sharded_state, shardings = shard_tree(
+        TrainState.create(big_params, optimizer),
+        mesh,
+        fsdp_rule(mesh, min_size=8),
+    )
+    assert not sharded_state.params["w"].is_fully_replicated
+    shard_path = os.path.join(ckpt_dir, "sharded")
+    save_checkpoint(shard_path, sharded_state)
+    fresh = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(jnp.zeros_like(x), s)
+        if isinstance(x, jax.Array)
+        else x,
+        sharded_state,
+        shardings,
+    )
+    restored_sharded = restore_checkpoint(shard_path, fresh)
+    assert (
+        restored_sharded.params["w"].sharding
+        == sharded_state.params["w"].sharding
+    )
+    local_ok = np.allclose(
+        np.asarray(
+            [np.asarray(s.data) for s in restored_sharded.params["w"].addressable_shards]
+        ),
+        np.asarray(
+            [np.asarray(s.data) for s in sharded_state.params["w"].addressable_shards]
+        ),
+    )
+    assert bool(fm.host_allreduce(np.asarray(float(local_ok)), op="min")), (
+        "sharded restore mismatch on some process"
+    )
+
+# --- ragged-shard loader lockstep ---
+# 14 samples over N procs: ceil partition gives the last rank a smaller
+# (or empty-padded) shard; every process must still yield the same number
+# of global batches or assembly deadlocks (this very loop hanging would
+# fail the parent's timeout).
+ragged_n = num_processes * 4 - 2
+ragged = fm.DistributedDataContainer(
+    fm.ArrayDataset((np.arange(ragged_n, dtype=np.float32).reshape(-1, 1),))
+)
+ragged_loader = fm.DistributedDataLoader(
+    ragged, global_batch_size=num_processes, mesh=mesh
+)
+n_batches = sum(1 for _ in ragged_loader)
+assert n_batches == len(ragged_loader)
+counts_equal = (
+    float(fm.host_allreduce(np.asarray(float(n_batches)), op="max"))
+    == float(fm.host_allreduce(np.asarray(float(n_batches)), op="min"))
+)
+assert counts_equal
+
 fm.barrier("final")
 print(f"WORKER_{process_id}_OK")

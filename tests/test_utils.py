@@ -54,3 +54,76 @@ def test_profile_trace(world, tmp_path):
     import os
 
     assert os.path.isdir(logdir)
+
+
+def test_sharded_checkpoint_roundtrip(world, tmp_path):
+    # VERDICT r1 next #6: an FSDP-sharded TrainState round-trips through the
+    # sharding-aware path — values AND shardings restored, no host gather.
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import fluxmpi_tpu as fm
+    from fluxmpi_tpu.parallel import TrainState, fsdp_rule, shard_tree
+    from fluxmpi_tpu.utils import restore_checkpoint, save_checkpoint
+
+    mesh = fm.global_mesh()
+    params = {
+        "w": jnp.arange(64 * 8, dtype=jnp.float32).reshape(64, 8),
+        "b": jnp.ones((8,)),
+    }
+    opt = optax.adam(1e-3)
+    state, shardings = shard_tree(
+        TrainState.create(params, opt), mesh, fsdp_rule(mesh, min_size=64)
+    )
+    assert not state.params["w"].sharding.is_fully_replicated
+
+    path = str(tmp_path / "sharded_ckpt")
+    save_checkpoint(path, state)
+
+    # Fresh zero-valued state in the same layout; restore must land every
+    # leaf back in its training sharding with the saved values.
+    fresh = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(jnp.zeros_like(x), s)
+        if isinstance(x, jax.Array)
+        else x,
+        state,
+        shardings,
+    )
+    restored = restore_checkpoint(path, fresh)
+    np.testing.assert_allclose(
+        np.asarray(restored.params["w"]), np.asarray(state.params["w"])
+    )
+    assert restored.params["w"].sharding == state.params["w"].sharding
+    mu = restored.opt_state[0].mu["w"]
+    assert mu.sharding == state.opt_state[0].mu["w"].sharding
+
+    # force=True overwrite works for the sharded path too.
+    save_checkpoint(path, restored)
+
+
+def test_checkpoint_layout_mismatch_raises(world, tmp_path):
+    # A sharded checkpoint restored with a replicated template (or vice
+    # versa) must fail with a clear layout error, not silently host-gather.
+    import jax
+    import jax.numpy as jnp
+    import optax
+    import pytest
+
+    import fluxmpi_tpu as fm
+    from fluxmpi_tpu.parallel import TrainState, fsdp_rule, shard_tree
+    from fluxmpi_tpu.parallel.train import replicate
+    from fluxmpi_tpu.utils import restore_checkpoint, save_checkpoint
+
+    mesh = fm.global_mesh()
+    params = {"w": jnp.ones((64, 8))}
+    opt = optax.sgd(0.1)
+    sharded, _ = shard_tree(
+        TrainState.create(params, opt), mesh, fsdp_rule(mesh, min_size=64)
+    )
+    path = str(tmp_path / "ck")
+    save_checkpoint(path, sharded)
+    replicated_like = replicate(TrainState.create(params, opt), mesh)
+    with pytest.raises(ValueError, match="sharded layout"):
+        restore_checkpoint(path, replicated_like)
