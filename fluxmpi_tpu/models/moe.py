@@ -7,8 +7,15 @@ declaration away. The layer is Switch-Transformer-style top-1 routing with
 static capacity, built entirely from dense einsums over static shapes so XLA
 can tile everything onto the MXU:
 
-- routing is a one-hot dispatch tensor ``[tokens, experts, capacity]``
-  (no gather/scatter, no dynamic shapes — the TPU-friendly formulation);
+- routing is grouped (mesh-TF/Switch style): tokens reshape to
+  ``[groups, group_size]`` (groups default to the batch dimension, which is
+  the dp-sharded one) and capacity/cumsum/dispatch are computed per group —
+  dispatch memory is ``O(group_size² · capacity_factor)`` per group rather
+  than one global ``O(tokens²)`` tensor, and the routing cumsum carries no
+  cross-shard sequential dependency, so it parallelizes over dp;
+- the dispatch itself is a one-hot tensor ``[groups, group_size, experts,
+  capacity]`` (no gather/scatter, no dynamic shapes — the TPU-friendly
+  formulation);
 - expert weights carry a leading ``num_experts`` dimension; shard it over an
   ``ep`` mesh axis (:func:`expert_parallel_rules`) and XLA turns the
   dispatch/combine einsums into all-to-alls over ICI;
@@ -38,11 +45,15 @@ __all__ = [
 
 
 class MoEMLP(nn.Module):
-    """Top-1 (Switch) mixture-of-experts feed-forward layer.
+    """Top-1 (Switch) mixture-of-experts feed-forward layer with grouped
+    routing.
 
-    Input/output ``(..., d_model)``; tokens = all leading dims flattened.
-    ``capacity_factor`` scales per-expert capacity
-    ``ceil(tokens / num_experts * capacity_factor)``.
+    Input/output ``(..., d_model)``. Tokens are routed per *group*:
+    ``n_groups`` explicit groups, or by default one group per leading
+    (batch) row for inputs of rank ≥ 3 — the dimension dp shards, so
+    routing stays shard-local. Per-expert capacity is per group:
+    ``ceil(group_size * capacity_factor / num_experts)`` (NOT over the
+    global token count); overflow drops are likewise group-local.
     """
 
     num_experts: int = 8
@@ -50,6 +61,7 @@ class MoEMLP(nn.Module):
     capacity_factor: float = 1.25
     dtype: jnp.dtype = jnp.float32
     router_noise: float = 0.0
+    n_groups: int | None = None
 
     @nn.compact
     def __call__(self, x, *, train: bool = True):
@@ -57,41 +69,60 @@ class MoEMLP(nn.Module):
         n_tokens = 1
         for s in lead:
             n_tokens *= s
-        tokens = x.reshape(n_tokens, d_model).astype(self.dtype)
+        # Token groups (ADVICE r1): capacity/cumsum/dispatch are computed
+        # per group so dispatch memory is O(group_size * capacity) per
+        # group and the cumsum never spans dp shards. Default: one group
+        # per leading (batch) row — the dimension dp shards.
+        if self.n_groups is not None:
+            groups = self.n_groups
+            if n_tokens % groups:
+                raise ValueError(
+                    f"n_groups {groups} must divide token count {n_tokens}"
+                )
+        else:
+            groups = lead[0] if len(lead) >= 2 else 1
+        gs = n_tokens // groups
+        tokens = x.reshape(groups, gs, d_model).astype(self.dtype)
 
         # Router (kept in f32: tiny, and argmax/softmax stability matters).
         router_w = self.param(
             "router", nn.initializers.lecun_normal(), (d_model, self.num_experts)
         )
-        logits = (tokens.astype(jnp.float32) @ router_w.astype(jnp.float32))
+        logits = jnp.einsum(
+            "gsd,de->gse", tokens.astype(jnp.float32), router_w.astype(jnp.float32)
+        )
         if self.router_noise > 0.0 and train:
             rng = self.make_rng("router")
             logits = logits + self.router_noise * jax.random.normal(
                 rng, logits.shape
             )
-        probs = jax.nn.softmax(logits, axis=-1)  # [N, E]
-        expert_idx = jnp.argmax(probs, axis=-1)  # [N]
+        probs = jax.nn.softmax(logits, axis=-1)  # [G, S, E]
+        expert_idx = jnp.argmax(probs, axis=-1)  # [G, S]
         expert_gate = jnp.take_along_axis(
-            probs, expert_idx[:, None], axis=-1
-        )[:, 0]  # [N]
+            probs, expert_idx[..., None], axis=-1
+        )[..., 0]  # [G, S]
 
         capacity = max(
-            1, int(-(-n_tokens * self.capacity_factor // self.num_experts))
+            1, int(-(-gs * self.capacity_factor // self.num_experts))
         )
         onehot = jax.nn.one_hot(expert_idx, self.num_experts, dtype=jnp.float32)
-        # Position of each token within its expert's buffer (0-based).
-        pos_in_expert = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # [N, E]
-        kept = (pos_in_expert < capacity) & (onehot > 0)  # [N, E] bool
+        # Position of each token within its expert's per-group buffer
+        # (0-based); the cumsum runs over the group-local token axis only.
+        pos_in_expert = (jnp.cumsum(onehot, axis=1) - 1.0) * onehot  # [G, S, E]
+        kept = (pos_in_expert < capacity) & (onehot > 0)  # [G, S, E] bool
         pos_oh = jax.nn.one_hot(
             pos_in_expert.astype(jnp.int32), capacity, dtype=jnp.float32
-        )  # [N, E, C]
-        dispatch = pos_oh * kept[..., None].astype(jnp.float32)  # [N, E, C]
-        combine = dispatch * expert_gate[:, None, None]  # [N, E, C]
+        )  # [G, S, E, C]
+        dispatch = pos_oh * kept[..., None].astype(jnp.float32)  # [G, S, E, C]
+        combine = dispatch * expert_gate[..., None, None]  # [G, S, E, C]
 
-        # Load-balancing aux loss (Switch eq. 4): E * sum_e f_e * P_e.
-        frac_tokens = jnp.mean(onehot, axis=0)
-        frac_probs = jnp.mean(probs, axis=0)
-        aux_loss = self.num_experts * jnp.sum(frac_tokens * frac_probs)
+        # Load-balancing aux loss (Switch eq. 4), computed per group and
+        # averaged: E * mean_g sum_e f_ge * P_ge.
+        frac_tokens = jnp.mean(onehot, axis=1)  # [G, E]
+        frac_probs = jnp.mean(probs, axis=1)  # [G, E]
+        aux_loss = self.num_experts * jnp.mean(
+            jnp.sum(frac_tokens * frac_probs, axis=-1)
+        )
         self.sow("losses", "moe_aux_loss", aux_loss)
 
         w1 = self.param(
@@ -108,13 +139,13 @@ class MoEMLP(nn.Module):
         b2 = self.param("b2", nn.initializers.zeros, (self.num_experts, d_model))
 
         expert_in = jnp.einsum(
-            "nec,nd->ecd", dispatch.astype(self.dtype), tokens
-        )  # [E, C, d_model]
-        h = jnp.einsum("ecd,edf->ecf", expert_in, w1.astype(self.dtype))
-        h = nn.gelu(h + b1[:, None, :].astype(self.dtype))
-        out = jnp.einsum("ecf,efd->ecd", h, w2.astype(self.dtype))
-        out = out + b2[:, None, :].astype(self.dtype)
-        y = jnp.einsum("nec,ecd->nd", combine.astype(self.dtype), out)
+            "gsec,gsd->gecd", dispatch.astype(self.dtype), tokens
+        )  # [G, E, C, d_model]
+        h = jnp.einsum("gecd,edf->gecf", expert_in, w1.astype(self.dtype))
+        h = nn.gelu(h + b1[None, :, None, :].astype(self.dtype))
+        out = jnp.einsum("gecf,efd->gecd", h, w2.astype(self.dtype))
+        out = out + b2[None, :, None, :].astype(self.dtype)
+        y = jnp.einsum("gsec,gecd->gsd", combine.astype(self.dtype), out)
         return y.reshape(*lead, d_model).astype(x.dtype)
 
 
@@ -125,6 +156,7 @@ class MoEEncoderBlock(EncoderBlock):
 
     num_experts: int = 8
     capacity_factor: float = 1.25
+    n_groups: int | None = None
 
     def make_ff(self) -> nn.Module:
         return MoEMLP(
@@ -132,6 +164,7 @@ class MoEEncoderBlock(EncoderBlock):
             d_ff=self.d_ff,
             capacity_factor=self.capacity_factor,
             dtype=self.dtype,
+            n_groups=self.n_groups,
             name="moe",
         )
 
@@ -141,6 +174,7 @@ class MoEEncoder(TransformerEncoder):
 
     num_experts: int = 8
     capacity_factor: float = 1.25
+    n_groups: int | None = None
 
     def make_block(self, i: int) -> nn.Module:
         return MoEEncoderBlock(
@@ -152,6 +186,7 @@ class MoEEncoder(TransformerEncoder):
             attention_fn=self.attention_fn,
             num_experts=self.num_experts,
             capacity_factor=self.capacity_factor,
+            n_groups=self.n_groups,
             name=f"block_{i}",
         )
 
@@ -164,6 +199,7 @@ class MoETransformerLM(TransformerLM):
 
     num_experts: int = 8
     capacity_factor: float = 1.25
+    n_groups: int | None = None
 
     def make_encoder(self) -> nn.Module:
         return MoEEncoder(
@@ -176,6 +212,7 @@ class MoETransformerLM(TransformerLM):
             attention_fn=self.attention_fn,
             num_experts=self.num_experts,
             capacity_factor=self.capacity_factor,
+            n_groups=self.n_groups,
             name="encoder",
         )
 

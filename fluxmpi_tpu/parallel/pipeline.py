@@ -6,16 +6,34 @@ more named axis. The formulation is TPU-idiomatic SPMD:
 
 - every stage's parameters carry a leading ``n_stages`` dimension sharded
   over the ``pp`` axis (one stage per device along that axis);
-- the whole schedule is ONE compiled ``lax.scan`` over ``M + S - 1`` ticks
-  (M microbatches, S stages): every device runs the stage function every
-  tick (bubble ticks compute on garbage and are masked out — the standard
-  SPMD pipeline trade), activations hop to the next stage via
-  ``lax.ppermute`` (one ICI neighbor hop, exactly what the torus wants);
-- the last stage accumulates its outputs and a final ``psum`` over the axis
-  replicates them (all other stages contribute zeros);
+- the whole schedule is ONE compiled ``lax.scan`` over the ticks: every
+  device runs the stage function every tick (bubble ticks compute on
+  garbage and are masked out — the standard SPMD pipeline trade),
+  activations hop to the next stage via ``lax.ppermute`` (one ICI neighbor
+  hop, exactly what the torus wants);
+- finished microbatches leave the last stage on a second ppermute
+  "conveyor" ring and are captured by their owning device, so the output
+  accumulator is **sharded over the pp axis** — each device stores only
+  ``M/S`` microbatches (one copy of the output across the axis, not S), and
+  there is no O(batch) psum at the end;
 - everything is differentiable (``ppermute`` transposes to the reverse
   permute), so the same schedule serves forward and backward — wrap the
   loss in :func:`jax.grad` as usual.
+
+Schedule economics (GPipe): with S stages and M microbatches the bubble
+fraction is ``(S-1)/(M+S-1)`` — drive it down with ``M >> S``. The sharded
+collection adds ≤ ``S-1`` conveyor ticks (second bubble) but removes the
+O(batch)-per-device accumulator the r1 implementation carried
+(ADVICE/VERDICT r1). A true 1F1B schedule changes *activation liveness*,
+not the bubble; here the equivalent memory lever is ``remat_stages=True``
+(``jax.checkpoint`` around each stage call), which recomputes stage
+forwards during the backward sweep so at most one tick's activations are
+live — the 1F1B working-set bound, paid in FLOPs instead of schedule
+complexity (the right trade on MXU-rich TPUs).
+
+Memory footprint: stage inputs ``x`` are replicated along ``pp`` (each
+device holds the full batch input); outputs are pp-sharded as above. The
+activation carry is one microbatch per device.
 
 The inter-stage activation must be uniform: ``stage_fn(params, x) -> y``
 with ``y.shape == x.shape`` AND ``y.dtype == x.dtype`` (the activation is
@@ -34,6 +52,23 @@ from .. import config
 from ._compat import shard_map_unchecked
 
 __all__ = ["pipeline_apply", "make_pipeline_fn", "stack_stage_params", "pipeline_rules"]
+
+
+def _check_stacked_leaves(tree: Any, expected_dim: int, what: str) -> None:
+    """Every leaf must carry a leading stage dimension of ``expected_dim``;
+    raise naming the offending leaf path (a raw Python scalar counts as
+    rank 0)."""
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        ndim = getattr(leaf, "ndim", 0)
+        if ndim == 0 or leaf.shape[0] != expected_dim:
+            got = (
+                "a scalar (rank 0)" if ndim == 0 else f"leading dim {leaf.shape[0]}"
+            )
+            raise ValueError(
+                f"stacked stage leaf {jax.tree_util.keystr(path)} has {got}, "
+                f"expected {what} {expected_dim}: build the tree with "
+                f"stack_stage_params over the per-stage parameter list"
+            )
 
 
 def stack_stage_params(stage_params_list: list[Any]) -> Any:
@@ -64,6 +99,7 @@ def pipeline_apply(
     *,
     n_microbatches: int,
     axis_name: str | None = None,
+    remat_stages: bool = False,
 ):
     """Run the stage-partitioned network over the bound ``pp`` axis.
 
@@ -71,18 +107,24 @@ def pipeline_apply(
     wrapper). ``stacked_params`` leaves arrive stage-local (leading dim 1 —
     the shard of the stacked tree); ``x`` is the full batch ``[B, ...]``,
     ``B`` divisible by ``n_microbatches``.
+
+    Returns the **pp-sharded** local output block ``[M_pad/S · mb, ...]``:
+    device ``d`` holds microbatches ``[d·M_pad/S, (d+1)·M_pad/S)`` (the
+    microbatch count padded up to a multiple of S). The jitted wrapper
+    re-assembles and trims this to the global ``[B, ...]``.
+
+    ``remat_stages=True`` wraps each stage call in ``jax.checkpoint`` —
+    the 1F1B-equivalent activation-memory bound (see module docstring).
     """
     axis_name = axis_name or config.PP_AXIS_NAME
     n_stages = jax.lax.axis_size(axis_name)
     stage_idx = jax.lax.axis_index(axis_name)
-    for leaf in jax.tree_util.tree_leaves(stacked_params):
-        if leaf.shape[0] != 1:
-            raise ValueError(
-                f"stacked stage leaf has local leading dim {leaf.shape[0]}, "
-                f"expected 1 — the stacked stage count must equal the "
-                f"'{axis_name}' axis size {n_stages}"
-            )
+    _check_stacked_leaves(
+        stacked_params, 1, f"local leading dim (the '{axis_name}'-axis shard)"
+    )
     params_local = jax.tree_util.tree_map(lambda p: p[0], stacked_params)
+    if remat_stages:
+        stage_fn = jax.checkpoint(stage_fn)
 
     batch = x.shape[0]
     if batch % n_microbatches:
@@ -104,11 +146,22 @@ def pipeline_apply(
             f"{(mb, *x.shape[1:])}/{x.dtype}"
         )
 
-    n_ticks = n_microbatches + n_stages - 1
+    # Pad the microbatch grid to a multiple of S so every device owns an
+    # equal output block (padding microbatches compute on stale input and
+    # are never captured; the wrapper trims them).
+    m_pad = -(-n_microbatches // n_stages) * n_stages
+    per_dev = m_pad // n_stages
+    # Finished microbatch w leaves stage S-1 at tick w+S-1, then rides the
+    # wrap-around conveyor one hop per tick; its owner (device w // per_dev)
+    # captures it after (owner+1) mod S hops — strictly before the slot
+    # wraps, so one conveyor register per device suffices.
+    n_ticks = m_pad + 2 * (n_stages - 1)
     fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
+    ring_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    hops = (stage_idx + 1) % n_stages  # conveyor distance from stage S-1
 
     def tick(carry, t):
-        act, acc = carry
+        act, conv, acc = carry
         # Stage 0 reads microbatch t from the input stream (clamped index —
         # past the last microbatch it computes on a stale copy and the
         # result is never written); later stages read the ppermuted
@@ -117,25 +170,39 @@ def pipeline_apply(
             stage_idx == 0, x_mb[jnp.minimum(t, n_microbatches - 1)], act
         )
         out = stage_fn(params_local, inp)
-        # The last stage finishes microbatch (t - (S-1)) at tick t.
-        widx = t - (n_stages - 1)
-        valid = jnp.logical_and(stage_idx == n_stages - 1, widx >= 0)
-        acc_written = jax.lax.dynamic_update_index_in_dim(
-            acc, out, jnp.maximum(widx, 0), 0
+
+        # Capture: the item arriving on this device's conveyor register this
+        # tick is microbatch t - (S-1) - hops (the last stage captures its
+        # own finished output directly, hops == 0).
+        item = jnp.where(stage_idx == n_stages - 1, out, conv)
+        widx = t - (n_stages - 1) - hops
+        mine = jnp.logical_and(
+            widx >= 0,
+            jnp.logical_and(
+                widx < n_microbatches, widx // per_dev == stage_idx
+            ),
         )
-        acc = jnp.where(valid, acc_written, acc)
+        local_idx = jnp.clip(widx - stage_idx * per_dev, 0, per_dev - 1)
+        acc = jnp.where(
+            mine,
+            jax.lax.dynamic_update_index_in_dim(acc, item, local_idx, 0),
+            acc,
+        )
+
+        # The last stage injects its finished output into the conveyor
+        # (overwriting the returning, already-captured item); everyone else
+        # forwards what arrived.
         act_next = jax.lax.ppermute(out, axis_name, fwd_perm)
-        return (act_next, acc), None
+        conv_next = jax.lax.ppermute(item, axis_name, ring_perm)
+        return (act_next, conv_next, acc), None
 
     act0 = jnp.zeros((mb, *x.shape[1:]), x.dtype)
-    acc0 = jnp.zeros((n_microbatches, mb, *x.shape[1:]), x.dtype)
-    (_, acc), _ = jax.lax.scan(tick, (act0, acc0), jnp.arange(n_ticks))
-
-    # Only the last stage holds real outputs; psum replicates them (other
-    # stages contribute zeros).
-    acc = jnp.where(stage_idx == n_stages - 1, acc, jnp.zeros_like(acc))
-    acc = jax.lax.psum(acc, axis_name)
-    return acc.reshape(batch, *x.shape[1:])
+    conv0 = jnp.zeros((mb, *x.shape[1:]), x.dtype)
+    acc0 = jnp.zeros((per_dev, mb, *x.shape[1:]), x.dtype)
+    (_, _, acc), _ = jax.lax.scan(
+        tick, (act0, conv0, acc0), jnp.arange(n_ticks)
+    )
+    return acc.reshape(per_dev * mb, *x.shape[1:])
 
 
 def make_pipeline_fn(
@@ -144,10 +211,14 @@ def make_pipeline_fn(
     *,
     n_microbatches: int,
     axis_name: str | None = None,
+    remat_stages: bool = False,
 ):
     """Jitted eager wrapper: ``fn(stacked_params, x) -> y`` with the stacked
     stage dimension laid over ``axis_name`` and the batch replicated along
-    it. Differentiable — compose with ``jax.value_and_grad`` for training."""
+    it. The output batch dimension comes back **sharded over the pp axis**
+    (each device stores only its owned microbatches — see
+    :func:`pipeline_apply`); downstream jit ops consume it transparently.
+    Differentiable — compose with ``jax.value_and_grad`` for training."""
     from ..runtime import global_mesh
 
     mesh = mesh or global_mesh()
@@ -160,10 +231,20 @@ def make_pipeline_fn(
             x,
             n_microbatches=n_microbatches,
             axis_name=axis_name,
+            remat_stages=remat_stages,
         )
 
     param_specs = P(axis_name)  # leading stage dim; rest replicated
     mapped = shard_map_unchecked(
-        body, mesh, in_specs=(param_specs, P()), out_specs=P()
+        body, mesh, in_specs=(param_specs, P()), out_specs=P(axis_name)
     )
-    return jax.jit(mapped)
+    n_stages = mesh.shape[axis_name]
+
+    @jax.jit
+    def fn(stacked_params, x):
+        _check_stacked_leaves(stacked_params, n_stages, "leading dim == n_stages")
+        y = mapped(stacked_params, x)
+        # Trim the microbatch padding (y covers M_pad ≥ M microbatches).
+        return y[: x.shape[0]]
+
+    return fn

@@ -140,3 +140,37 @@ def test_expert_parallel_train_step(world):
     # Layout preserved across steps.
     w1 = state.params["params"]["encoder"]["block_0"]["moe"]["w1"]
     assert tuple(w1.sharding.spec)[0] == "ep"
+
+
+def test_grouped_routing_is_group_local(world):
+    """Routing/capacity are per group (default: one group per batch row) —
+    overflow in one row cannot displace another row's tokens, and the
+    cumsum carries no cross-row dependency (ADVICE r1)."""
+    from fluxmpi_tpu.models import MoEMLP
+
+    n_rows, n_tokens, d = 3, 8, 4
+    model = MoEMLP(num_experts=2, d_ff=8, capacity_factor=0.5)  # cap 2/row
+    x = jnp.ones((n_rows, n_tokens, d), jnp.float32)
+    params = model.init(jax.random.PRNGKey(1), x)
+    y = np.asarray(model.apply(params, x))
+
+    norms = np.linalg.norm(y, axis=-1)  # [rows, tokens]
+    for r in range(n_rows):
+        assert np.all(norms[r, :2] > 0), f"row {r} within-capacity dropped"
+        np.testing.assert_allclose(norms[r, 2:], 0.0, atol=1e-7)
+
+
+def test_grouped_routing_explicit_groups(world):
+    from fluxmpi_tpu.models import MoEMLP
+
+    x = jnp.ones((1, 12, 4), jnp.float32)
+    model = MoEMLP(num_experts=2, d_ff=8, n_groups=3, capacity_factor=1.0)
+    params = model.init(jax.random.PRNGKey(2), x)
+    y = model.apply(params, x)
+    assert y.shape == x.shape
+
+    bad = MoEMLP(num_experts=2, d_ff=8, n_groups=5)
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="must divide token count"):
+        bad.init(jax.random.PRNGKey(2), x)

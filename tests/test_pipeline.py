@@ -131,3 +131,108 @@ def test_pipeline_batch_divisibility_error(world):
     x = jnp.ones((7, 4), jnp.float32)
     with pytest.raises(ValueError, match="not divisible"):
         make_pipeline_fn(_stage_fn, mesh, n_microbatches=2)(stacked, x)
+
+
+def test_pipeline_transformer_stage_grads_exact(world):
+    """VERDICT r1 next #9 done-criterion: a real transformer-block stage_fn
+    at pp=2 is gradient-exact against the sequential stack."""
+    from fluxmpi_tpu.models.transformer import EncoderBlock
+    from fluxmpi_tpu.parallel.pipeline import make_pipeline_fn, stack_stage_params
+
+    d_model, seq, batch = 16, 8, 4
+    block = EncoderBlock(d_model=d_model, num_heads=2, d_ff=32, dropout=0.0,
+                         dtype=jnp.float32)
+
+    def stage_fn(params, x):
+        return block.apply({"params": params}, x, train=False)
+
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.normal(size=(batch, seq, d_model)).astype(np.float32))
+    stages = [
+        block.init(jax.random.PRNGKey(i), x, train=False)["params"]
+        for i in range(2)
+    ]
+    stacked = stack_stage_params(stages)
+    mesh = _mesh_pp(2)
+
+    pipe = make_pipeline_fn(stage_fn, mesh, n_microbatches=2)
+    y_target = jnp.asarray(
+        rng.normal(size=(batch, seq, d_model)).astype(np.float32)
+    )
+
+    def pipe_loss(p):
+        return jnp.mean((pipe(p, x) - y_target) ** 2)
+
+    def seq_loss(stages_list):
+        h = x
+        for p in stages_list:
+            h = stage_fn(p, h)
+        return jnp.mean((h - y_target) ** 2)
+
+    np.testing.assert_allclose(
+        float(pipe_loss(stacked)), float(seq_loss(stages)), rtol=1e-5
+    )
+    g_pipe = jax.grad(pipe_loss)(stacked)
+    g_seq = jax.grad(seq_loss)(stages)
+    for s in range(2):
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6
+            ),
+            jax.tree_util.tree_map(lambda l: l[s], g_pipe),
+            g_seq[s],
+        )
+
+
+def test_pipeline_remat_matches(world):
+    """remat_stages=True (the 1F1B-equivalent activation-memory lever) is
+    numerically identical in forward and backward."""
+    from fluxmpi_tpu.parallel.pipeline import make_pipeline_fn, stack_stage_params
+
+    n_stages, d = 4, 8
+    mesh = _mesh_pp(n_stages)
+    stages = _stages(n_stages, d, seed=8)
+    stacked = stack_stage_params(stages)
+    x = jnp.asarray(
+        np.random.default_rng(9).normal(size=(8, d)).astype(np.float32)
+    )
+
+    plain = make_pipeline_fn(_stage_fn, mesh, n_microbatches=4)
+    remat = make_pipeline_fn(_stage_fn, mesh, n_microbatches=4,
+                             remat_stages=True)
+    np.testing.assert_allclose(
+        np.asarray(plain(stacked, x)), np.asarray(remat(stacked, x)),
+        rtol=1e-6,
+    )
+    gp = jax.grad(lambda p: jnp.mean(plain(p, x) ** 2))(stacked)
+    gr = jax.grad(lambda p: jnp.mean(remat(p, x) ** 2))(stacked)
+    for a, b in zip(jax.tree_util.tree_leaves(gp), jax.tree_util.tree_leaves(gr)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+
+
+def test_pipeline_scalar_leaf_clear_error(world):
+    """ADVICE r1: an unstacked scalar leaf raises a clear ValueError naming
+    the leaf path, not an IndexError."""
+    from fluxmpi_tpu.parallel.pipeline import make_pipeline_fn, stack_stage_params
+
+    mesh = _mesh_pp(2)
+    stacked = stack_stage_params(_stages(2, 4))
+    stacked["gamma"] = jnp.float32(1.0)  # rank-0 intruder
+    x = jnp.ones((4, 4), jnp.float32)
+    with pytest.raises(ValueError, match="gamma.*scalar|scalar.*gamma"):
+        make_pipeline_fn(_stage_fn, mesh, n_microbatches=2)(stacked, x)
+
+
+def test_pipeline_output_sharded_over_pp(world):
+    """The output accumulator is pp-sharded (one copy across the axis), not
+    replicated — each device stores only its owned microbatches."""
+    from fluxmpi_tpu.parallel.pipeline import make_pipeline_fn, stack_stage_params
+
+    n_stages, d = 4, 8
+    mesh = _mesh_pp(n_stages)
+    stacked = stack_stage_params(_stages(n_stages, d, seed=10))
+    x = jnp.ones((8, d), jnp.float32)
+    y = make_pipeline_fn(_stage_fn, mesh, n_microbatches=4)(stacked, x)
+    assert not y.is_fully_replicated
+    shard_rows = {s.data.shape[0] for s in y.addressable_shards}
+    assert shard_rows == {x.shape[0] // n_stages}
