@@ -1,32 +1,37 @@
 """Benchmark harness: prints ONE JSON line with the headline metric.
 
 Flagship workload (BASELINE.md): ResNet-50 synthetic-ImageNet DP training
-throughput in images/sec/chip (BASELINE config 3), with MFU and a loader-fed
+throughput in images/sec/chip (BASELINE config 3), with MFU, a loader-fed
 variant (batches drawn through DistributedDataLoader + the C++ prefetcher,
-host→device transfer on the measured path).
+host→device transfer on the measured path), a flash-vs-dense attention
+comparison, and a DP scaling-efficiency measurement.
 
-Resilience design (this is what failed in round 1 — rc 124, no metric):
-  1. A ≤60 s *probe* child first initializes the backend and runs one tiny
-     matmul. A wedged TPU (jax.devices() hanging on the tunnel) costs one
-     probe timeout, retried with backoff, instead of burning a workload
-     budget.
-  2. Per-config child timeouts (600 s resnet50 / 300 s cnn / 150 s mlp) sum
-     comfortably under the driver's budget; an overall wall budget
-     (FLUXMPI_TPU_BENCH_BUDGET, default 1500 s) clamps every child so the
-     harness always prints *something* before the driver's axe falls.
-  3. If the accelerator never comes up, the MLP config runs CPU-pinned as a
-     last resort — a metric line appears within ~3 minutes no matter what.
+Timing discipline (this is what silently broke in round 2's first TPU
+number): on tunneled/remote TPU targets ``jax.block_until_ready`` can
+return without waiting for execution, and every host↔device sync costs a
+fixed ~90 ms round trip. Every measurement here therefore (a) forces
+synchronization by ``device_get``-ing the scalar loss, and (b) uses a
+two-point slope — time N1 steps and N2 steps, rate = (N2-N1)/(t2-t1) — so
+the fixed sync cost cancels exactly.
+
+Probe design (round-2 verdict #1): the liveness probe tries platform
+variants in order (env default → ``JAX_PLATFORMS=''`` auto-choice →
+explicit ``tpu``) with per-attempt timeouts 120/240/300 s
+(env-overridable), and every attempt's outcome lands in the output JSON
+under ``probe`` so a dead chip is distinguishable from a harness bug.
 
 ``vs_baseline``: the reference publishes no numbers (BASELINE.md
-"published: {}"), so the ratio is against this repo's own recorded anchor
-(first real number per metric, recorded in _ANCHORS) where one exists,
-else 1.0.
+"published: {}"), so the ratio is against this repo's own recorded anchor,
+keyed by (metric, platform, device fingerprint) so a number from another
+machine is never presented as a regression ratio.
 
 Env knobs:
-  FLUXMPI_TPU_BENCH_CONFIG    force one config (resnet50|cnn|mlp)
+  FLUXMPI_TPU_BENCH_CONFIG    force one config (resnet50|cnn|mlp|attention)
   FLUXMPI_TPU_BENCH_TIMEOUT   override per-config child timeout in seconds
   FLUXMPI_TPU_BENCH_BUDGET    overall wall budget in seconds (default 1500)
   FLUXMPI_TPU_BENCH_PLATFORM  pin jax_platforms in children (e.g. "cpu")
+  FLUXMPI_TPU_BENCH_PROBE_TIMEOUTS  comma list of probe timeouts (s)
+  FLUXMPI_TPU_BENCH_DEVICES   child uses only the first N devices
   FLUXMPI_TPU_COMPILE_CACHE   persistent XLA compile cache dir
 """
 
@@ -46,15 +51,20 @@ _CONFIGS: tuple[tuple[str, float], ...] = (
     ("cnn", 300.0),
     ("mlp", 150.0),
 )
-_PROBE_TIMEOUTS = (60.0, 60.0, 90.0)
+_DEFAULT_PROBE_TIMEOUTS = (120.0, 240.0, 300.0)
+# Platform variant tried at each probe attempt: None = leave the env alone,
+# "" = JAX_PLATFORMS='' (let jax auto-pick — round 1's own error message
+# suggested exactly this), "tpu" = demand the TPU backend.
+_PROBE_PLATFORMS = (None, "", "tpu")
 
-# First real recorded number per (metric, platform) — the vs_baseline
-# anchor (VERDICT r1 weak #8: never leave this a hardcoded 1.0 once a number
-# lands). CPU anchors recorded 2026-07-29 on the build host; TPU anchors
-# land with the first healthy-chip run.
-_ANCHORS: dict[tuple[str, str], float] = {
-    ("mlp_quickstart_samples_per_sec_per_chip", "cpu"): 84080.6,
-    ("cifar_cnn_images_per_sec_per_chip", "cpu"): 319.3,
+# First real recorded number per (metric, platform, device fingerprint) —
+# the vs_baseline anchor. TPU anchor recorded 2026-07-29, first healthy-chip
+# round (slope-timed, device_get-synced); CPU anchors from the round-2 build
+# host (1-core container, 8 virtual devices).
+_ANCHORS: dict[tuple[str, str, str], float] = {
+    ("resnet50_images_per_sec_per_chip", "tpu", "TPU v5 lite"): 2509.5,
+    ("mlp_quickstart_samples_per_sec_per_chip", "cpu", "cpu1"): 84080.6,
+    ("cifar_cnn_images_per_sec_per_chip", "cpu", "cpu1"): 319.3,
 }
 
 # Peak bf16 FLOPs/s per chip by device_kind substring (public spec sheets).
@@ -77,11 +87,32 @@ def _chip_peak_flops(device_kind: str) -> float | None:
     return None
 
 
-def _enable_compilation_cache() -> None:
-    """Persist compiled XLA programs so repeat bench runs skip the (slow)
-    first compile."""
+def _device_fingerprint(platform: str, device_kind: str) -> str:
+    """Anchor key component: the device kind on accelerators; on CPU the
+    core count too (throughput scales with it across hosts)."""
+    if platform == "cpu":
+        return f"cpu{os.cpu_count()}"
+    return device_kind
+
+
+def _anchor_for(metric: str) -> float | None:
     import jax
 
+    platform = jax.default_backend()
+    fp = _device_fingerprint(platform, jax.devices()[0].device_kind)
+    return _ANCHORS.get((metric, platform, fp))
+
+
+def _enable_compilation_cache() -> None:
+    """Persist compiled XLA programs so repeat bench runs skip the (slow)
+    first compile. TPU only: XLA:CPU persists AOT executables keyed too
+    loosely — an entry compiled on a host with different CPU features loads
+    anyway ("may SIGILL") and in practice kills device threads, wedging
+    8-device collective rendezvous."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return
     cache_dir = os.environ.get(
         "FLUXMPI_TPU_COMPILE_CACHE", "/tmp/fluxmpi_tpu_xla_cache"
     )
@@ -92,20 +123,59 @@ def _enable_compilation_cache() -> None:
         pass
 
 
-def _steps_per_sec(step, state, data, warmup: int, steps: int):
-    """Time `steps` compiled steps after warmup; returns (steps/second,
-    final state) — the state must be carried because the compiled step
-    donates its input buffers."""
+def _sync(x) -> None:
+    """Force device completion. ``device_get`` of a scalar is the only sync
+    that provably waits on tunneled targets where ``block_until_ready``
+    returns immediately."""
     import jax
 
+    np.asarray(jax.device_get(x))
+
+
+def _sync_each_step() -> bool:
+    """On CPU (virtual 8-device meshes), back-to-back async dispatch of
+    donating collective programs can interleave run instances on the
+    shared thread pool and wedge XLA:CPU's in-process rendezvous (observed:
+    7/8 participants arrive, 40 s kill timer). A per-step sync serializes
+    launches and costs nothing without a device tunnel; on TPU the async
+    loop stands (per-step sync would add the ~90 ms round trip each step)."""
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
+def _timed_steps(step, state, data, n: int):
+    per_step = _sync_each_step()
+    t0 = time.perf_counter()
+    loss = None
+    for _ in range(n):
+        state, loss = step(state, data)
+        if per_step:
+            _sync(loss)
+    _sync(loss)
+    return time.perf_counter() - t0, state
+
+
+def _steps_per_sec(step, state, data, warmup: int, steps: int):
+    """Slope-timed steps/second: two measurements of different length so the
+    fixed per-sync host↔device round trip cancels. The state is carried
+    because the compiled step donates its input buffers."""
+    per_step = _sync_each_step()
+    loss = None
     for _ in range(warmup):
         state, loss = step(state, data)
-    jax.block_until_ready(loss)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, loss = step(state, data)
-    jax.block_until_ready(loss)
-    return steps / (time.perf_counter() - t0), state
+        if per_step:
+            _sync(loss)
+    if loss is not None:
+        _sync(loss)
+    n1 = max(2, steps // 5)
+    t1, state = _timed_steps(step, state, data, n1)
+    t2, state = _timed_steps(step, state, data, steps)
+    if t2 > t1:
+        rate = (steps - n1) / (t2 - t1)
+    else:  # degenerate clock resolution; fall back to the longer run
+        rate = steps / t2
+    return rate, state
 
 
 def _cost_analysis_flops(step, state, data) -> float | None:
@@ -123,17 +193,34 @@ def _cost_analysis_flops(step, state, data) -> float | None:
     return None
 
 
-def _mfu(flops_per_step: float | None, rate: float, n_dev: int) -> float | None:
-    """Model FLOPs utilization per chip: analytic FLOPs/step × steps/sec ÷
-    (chips × peak)."""
-    import jax
-
+def _mfu(
+    flops_per_step: float | None, rate: float, n_dev: int, device_kind: str
+) -> float | None:
+    """Model FLOPs utilization per chip: FLOPs/step × steps/sec ÷
+    (chips × peak). Returns None when peak is unknown or the number is
+    impossible (>1: a broken clock or FLOPs estimate, never real)."""
     if not flops_per_step:
         return None
-    peak = _chip_peak_flops(jax.devices()[0].device_kind)
+    peak = _chip_peak_flops(device_kind)
     if peak is None:
         return None
-    return round(flops_per_step * rate / (n_dev * peak), 4)
+    mfu = flops_per_step * rate / (n_dev * peak)
+    if mfu > 1.0:
+        print(f"bench: discarding impossible MFU {mfu:.2f}", file=sys.stderr)
+        return None
+    return round(mfu, 4)
+
+
+def _visible_devices():
+    """jax.devices(), optionally truncated to FLUXMPI_TPU_BENCH_DEVICES —
+    the submesh hook the scaling-efficiency mode uses."""
+    import jax
+
+    devs = jax.devices()
+    limit = os.environ.get("FLUXMPI_TPU_BENCH_DEVICES")
+    if limit:
+        devs = devs[: int(limit)]
+    return devs
 
 
 def _bench_workload(
@@ -157,8 +244,10 @@ def _bench_workload(
     from fluxmpi_tpu.parallel import TrainState, make_train_step
     from fluxmpi_tpu.parallel.train import replicate, shard_batch
 
-    mesh = fm.init()
+    devs = _visible_devices()
+    mesh = fm.init(devices=devs)
     n_dev = fm.total_workers()
+    device_kind = devs[0].device_kind
     model, x, y, loss_fn, optimizer = make_model_batch(n_dev)
 
     if stateful:
@@ -175,27 +264,35 @@ def _bench_workload(
 
     # Cost analysis first: it lowers/compiles without executing, so it must
     # see the state before the donating timed steps consume its buffers.
-    flops_per_step = _cost_analysis_flops(step, state, data)
+    xla_flops = _cost_analysis_flops(step, state, data)
     batch = int(x.shape[0])
-    if flops_per_step is None and analytic_flops_per_sample is not None:
-        flops_per_step = analytic_flops_per_sample * batch
+    analytic_flops = (
+        analytic_flops_per_sample * batch
+        if analytic_flops_per_sample is not None
+        else None
+    )
+    # Prefer the documented analytic formula; XLA's cost model counts
+    # transcendentals and rematerialized ops differently across versions.
+    flops_per_step = analytic_flops if analytic_flops else xla_flops
 
     rate, state = _steps_per_sec(step, state, data, warmup=3, steps=steps)
-    mfu = _mfu(flops_per_step, rate, n_dev)
+    mfu = _mfu(flops_per_step, rate, n_dev, device_kind)
 
     value = round(batch * rate / n_dev, ndigits)
-    anchor = _ANCHORS.get((metric_name, jax.default_backend()))
+    anchor = _anchor_for(metric_name)
     result = {
         "metric": metric_name,
         "value": value,
         "unit": unit,
         "vs_baseline": round(value / anchor, 4) if anchor else 1.0,
         "platform": jax.default_backend(),
-        "device_kind": jax.devices()[0].device_kind,
+        "device_kind": device_kind,
         "n_chips": n_dev,
     }
     if mfu is not None:
         result["mfu"] = mfu
+    if xla_flops and flops_per_step is not analytic_flops:
+        result["flops_source"] = "xla_cost_analysis"
 
     if loader_fed:
         fed = _loader_fed_rate(step=step, state=state, x=x, y=y,
@@ -208,10 +305,11 @@ def _bench_workload(
 def _loader_fed_rate(*, step, state, x, y, mesh, n_dev) -> float | None:
     """Re-time the same compiled step drawing batches through
     DistributedDataLoader + the C++ NativePrefetcher over host numpy data —
-    host→device transfer included (VERDICT r1 missing #4: the input pipeline
-    must be on the measured path). The state is carried through every call
-    because the compiled step donates its input buffers."""
-    import jax
+    host→device transfer included (the input pipeline must be on the
+    measured path). Note: on a tunneled dev TPU every batch crosses the
+    tunnel, so this number is transfer-bound there; on a real TPU VM the
+    transfer is local PCIe/DMA."""
+    import jax  # noqa: F401  (device runtime must be up)
 
     from fluxmpi_tpu.data import ArrayDataset, DistributedDataLoader
 
@@ -239,7 +337,7 @@ def _loader_fed_rate(*, step, state, x, y, mesh, n_dev) -> float | None:
                     done += 1
                     if done >= n_steps:
                         break
-            jax.block_until_ready(loss)
+            _sync(loss)
             return n_steps / (time.perf_counter() - t0), state
 
         _, state = run(2, state)  # warmup: prefetcher spin-up
@@ -279,7 +377,7 @@ def _bench_resnet50():  # pragma: no cover - requires accelerator time
         from fluxmpi_tpu.models import ResNet50
 
         model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
-        batch = 64 * n_dev
+        batch = 128 * n_dev
         x = jnp.ones((batch, 224, 224, 3), jnp.bfloat16)
         y = jnp.zeros((batch,), jnp.int32)
         return model, x, y, _bn_loss(model), optax.sgd(0.1, momentum=0.9)
@@ -289,7 +387,7 @@ def _bench_resnet50():  # pragma: no cover - requires accelerator time
         stateful=True,
         metric_name="resnet50_images_per_sec_per_chip",
         unit="images/sec/chip",
-        steps=20,
+        steps=30,
         ndigits=2,
         # ~4.09 GFLOPs fwd per 224² image; train step ≈ 3× fwd (fwd + 2× bwd).
         analytic_flops_per_sample=3 * 4.09e9,
@@ -329,7 +427,11 @@ def _bench_mlp():
         from fluxmpi_tpu.models import MLP
 
         model = MLP(features=(256, 256, 256, 1))
-        batch = 8192 * n_dev
+        # Per-chip batch; the scaling mode shrinks it (on a 1-core host, 8
+        # virtual devices × 8192 samples serialize past XLA:CPU's 40 s
+        # collective-rendezvous kill timer).
+        per_chip = int(os.environ.get("FLUXMPI_TPU_BENCH_MLP_BATCH", "8192"))
+        batch = per_chip * n_dev
         rng = np.random.default_rng(0)
         x = jnp.asarray(rng.uniform(-2, 2, size=(batch, 1)).astype(np.float32))
         y = x**2
@@ -353,10 +455,114 @@ def _bench_mlp():
     )
 
 
-def _spawn(args: list[str], timeout: float, platform: str | None):
+def _bench_attention():
+    """Flash (Pallas) vs XLA dense attention, fwd+bwd, bf16 — the "fast,
+    not just correct" check on the one first-party kernel. Headline value is
+    flash tokens/sec at the longest sequence; per-seq detail rides along."""
+    import jax
+    import jax.numpy as jnp
+
+    from fluxmpi_tpu.ops import flash_attention
+
+    on_tpu = jax.default_backend() == "tpu"
+    b, h, d = 4, 8, 64
+    seqs = (2048, 4096, 8192) if on_tpu else (512,)
+    detail = {}
+    flash_rate = dense_rate = None
+
+    def _dense(q, k, v):
+        scale = 1.0 / np.sqrt(d)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        sq = q.shape[1]
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sq)[None, :]
+        s = jnp.where(mask[None, None], s, -1e30)
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+    def _grad_step(attend):
+        def loss(q, k, v):
+            return jnp.sum(attend(q, k, v).astype(jnp.float32))
+
+        # One fused dispatch per step: grads AND the scalar sync probe live
+        # in the same compiled program (separate host-side indexing ops cost
+        # a tunnel round trip each on remote targets).
+        @jax.jit
+        def g(q, k, v):
+            dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+            return dq[0, 0, 0, 0] + dk[0, 0, 0, 0] + dv[0, 0, 0, 0]
+
+        def step(state, data):
+            return state, g(*data)
+
+        return step
+
+    for seq in seqs:
+        rng = jax.random.PRNGKey(0)
+        kq, kk, kv = jax.random.split(rng, 3)
+        shape = (b, seq, h, d)
+        q = jax.random.normal(kq, shape, jnp.bfloat16)
+        k = jax.random.normal(kk, shape, jnp.bfloat16)
+        v = jax.random.normal(kv, shape, jnp.bfloat16)
+        data = (q, k, v)
+
+        flash_step = _grad_step(
+            lambda q, k, v: flash_attention(q, k, v, causal=True)
+        )
+        dense_step = _grad_step(_dense)
+        steps = max(4, min(20, (1 << 22) // seq))
+        try:
+            flash_rate, _ = _steps_per_sec(flash_step, None, data, 2, steps)
+        except Exception as exc:  # keep shorter-seq results on a long-seq OOM
+            print(f"bench: flash attention failed at {seq}: {exc!r}",
+                  file=sys.stderr)
+            break
+        try:
+            dense_rate, _ = _steps_per_sec(dense_step, None, data, 2, steps)
+        except Exception as exc:  # dense OOMs first at long seq
+            print(f"bench: dense attention failed at {seq}: {exc!r}",
+                  file=sys.stderr)
+            dense_rate = None
+        detail[str(seq)] = {
+            "flash_tokens_per_sec": round(b * seq * flash_rate, 1),
+            "dense_tokens_per_sec": (
+                round(b * seq * dense_rate, 1) if dense_rate else None
+            ),
+            "flash_speedup": (
+                round(flash_rate / dense_rate, 3) if dense_rate else None
+            ),
+        }
+
+    if not detail:
+        raise RuntimeError("no attention sequence length completed")
+    seq = max(int(s) for s in detail)
+    value = detail[str(seq)]["flash_tokens_per_sec"]
+    result = {
+        "metric": "flash_attention_tokens_per_sec",
+        "value": value,
+        "unit": f"tokens/sec (causal fwd+bwd, seq={seq}, bf16)",
+        "vs_baseline": 1.0,
+        "platform": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "per_seq": detail,
+    }
+    return result
+
+
+_CHILD_FNS = {
+    "resnet50": _bench_resnet50,
+    "cnn": _bench_cnn,
+    "mlp": _bench_mlp,
+    "attention": _bench_attention,
+}
+
+
+def _spawn(args: list[str], timeout: float, platform: str | None,
+           extra_env: dict[str, str] | None = None):
     env = dict(os.environ)
-    if platform:
+    if platform is not None:
         env["FLUXMPI_TPU_BENCH_PLATFORM"] = platform
+    if extra_env:
+        env.update(extra_env)
     return subprocess.run(
         [sys.executable, os.path.abspath(__file__), *args],
         capture_output=True,
@@ -377,20 +583,34 @@ def _parse_json_line(stdout: str) -> dict | None:
     return None
 
 
-def _run_probe(timeout: float, platform: str | None) -> dict | None:
+def _stderr_tail(proc) -> str:
+    return " | ".join((proc.stderr or "").strip().splitlines()[-3:])
+
+
+def _run_probe(timeout: float, platform: str | None, attempts: list) -> dict | None:
     """Backend liveness probe in a child: init + one tiny matmul. A hung
-    tunnel costs `timeout` seconds here instead of a workload budget."""
+    tunnel costs `timeout` seconds here instead of a workload budget. Every
+    attempt's outcome is appended to `attempts` for the output JSON."""
+    record = {
+        "platform_variant": "env-default" if platform is None else platform,
+        "timeout_s": timeout,
+    }
+    attempts.append(record)
+    t0 = time.monotonic()
     try:
         proc = _spawn(["--probe"], timeout, platform)
     except subprocess.TimeoutExpired:
+        record.update(ok=False, error=f"timed out after {timeout:.0f}s")
         print(f"bench: probe timed out after {timeout:.0f}s", file=sys.stderr)
         return None
+    record["elapsed_s"] = round(time.monotonic() - t0, 1)
     result = _parse_json_line(proc.stdout)
     if result and result.get("ok"):
+        record.update(ok=True, **{k: v for k, v in result.items() if k != "ok"})
         return result
-    tail = (proc.stderr or "").strip().splitlines()[-3:]
+    record.update(ok=False, exit=proc.returncode, error=_stderr_tail(proc))
     print(
-        f"bench: probe failed (exit {proc.returncode}): " + " | ".join(tail),
+        f"bench: probe failed (exit {proc.returncode}): " + _stderr_tail(proc),
         file=sys.stderr,
     )
     return None
@@ -398,6 +618,8 @@ def _run_probe(timeout: float, platform: str | None) -> dict | None:
 
 def _probe_main() -> None:
     platform = os.environ.get("FLUXMPI_TPU_BENCH_PLATFORM")
+    if platform == "":
+        os.environ.pop("JAX_PLATFORMS", None)
     import jax
 
     if platform:
@@ -406,7 +628,7 @@ def _probe_main() -> None:
     import jax.numpy as jnp
 
     x = jnp.ones((128, 128), jnp.bfloat16)
-    jax.block_until_ready(x @ x)
+    np.asarray(jax.device_get(x @ x))
     print(
         json.dumps(
             {
@@ -420,21 +642,25 @@ def _probe_main() -> None:
     )
 
 
-def _run_child(config: str, timeout: float, platform: str | None) -> dict | None:
+def _run_child(
+    config: str,
+    timeout: float,
+    platform: str | None,
+    extra_env: dict[str, str] | None = None,
+) -> dict | None:
     """Run one bench config in a child process; parse its final JSON line.
     Returns None on timeout/crash/garbage so the caller can fall back."""
     try:
-        proc = _spawn(["--child", config], timeout, platform)
+        proc = _spawn(["--child", config], timeout, platform, extra_env)
     except subprocess.TimeoutExpired:
         print(f"bench: {config} timed out after {timeout:.0f}s", file=sys.stderr)
         return None
     result = _parse_json_line(proc.stdout)
     if result and "metric" in result:
         return result
-    tail = (proc.stderr or "").strip().splitlines()[-3:]
     print(
         f"bench: {config} produced no metric (exit {proc.returncode}): "
-        + " | ".join(tail),
+        + _stderr_tail(proc),
         file=sys.stderr,
     )
     return None
@@ -442,6 +668,8 @@ def _run_child(config: str, timeout: float, platform: str | None) -> dict | None
 
 def _child_main(config: str) -> None:
     platform = os.environ.get("FLUXMPI_TPU_BENCH_PLATFORM")
+    if platform == "":
+        os.environ.pop("JAX_PLATFORMS", None)
     if platform:
         # The environment's sitecustomize may force-register a TPU platform
         # that wins over the JAX_PLATFORMS env var; pin the config directly.
@@ -449,8 +677,66 @@ def _child_main(config: str) -> None:
 
         jax.config.update("jax_platforms", platform)
     _enable_compilation_cache()
-    fn = {"resnet50": _bench_resnet50, "cnn": _bench_cnn, "mlp": _bench_mlp}[config]
-    print(json.dumps(fn()), flush=True)
+    print(json.dumps(_CHILD_FNS[config]()), flush=True)
+
+
+def _probe_timeouts() -> tuple[float, ...]:
+    raw = os.environ.get("FLUXMPI_TPU_BENCH_PROBE_TIMEOUTS")
+    if raw:
+        return tuple(float(t) for t in raw.split(",") if t.strip())
+    return _DEFAULT_PROBE_TIMEOUTS
+
+
+def _scaling_efficiency(per_chip_1: float, per_chip_n: float) -> float:
+    """DP scaling efficiency: per-chip throughput at dp=N as a fraction of
+    per-chip throughput at dp=1 (1.0 = perfect linear scaling)."""
+    if per_chip_1 <= 0:
+        return 0.0
+    return round(per_chip_n / per_chip_1, 4)
+
+
+def _run_scaling(
+    remaining_s: float,
+    accel_probe: dict | None,
+    accel_platform: str | None = None,
+) -> dict | None:
+    """DP scaling-efficiency measurement: the mlp workload at dp=1 vs dp=N,
+    same per-chip batch (weak scaling). On a multi-chip accelerator this
+    runs on the chips (submesh via FLUXMPI_TPU_BENCH_DEVICES), using the
+    platform variant the probe succeeded with; on a single-chip or dead
+    accelerator it runs on an 8-virtual-device CPU mesh — efficiency
+    numbers there prove the plumbing, not the ICI."""
+    n_accel = (accel_probe or {}).get("n_devices", 0)
+    if accel_probe and n_accel > 1:
+        platform, n, extra = accel_platform, n_accel, {}
+        mode = "accelerator"
+    else:
+        platform, n = "cpu", 8
+        # Append (not clobber) — the operator's own XLA_FLAGS survive; for
+        # duplicated flags the last occurrence wins in XLA's parser.
+        flags = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+        extra = {"XLA_FLAGS": flags}
+        mode = "cpu-virtual"
+    per_child = min(240.0, (remaining_s - 10) / 2)
+    if per_child < 45:
+        return None
+    extra = {**extra, "FLUXMPI_TPU_BENCH_MLP_BATCH": "512"}
+    r1 = _run_child("mlp", per_child, platform,
+                    {**extra, "FLUXMPI_TPU_BENCH_DEVICES": "1"})
+    rn = _run_child("mlp", per_child, platform,
+                    {**extra, "FLUXMPI_TPU_BENCH_DEVICES": str(n)})
+    if not (r1 and rn):
+        return None
+    return {
+        "mode": mode,
+        "n_chips": rn.get("n_chips", n),
+        "per_chip_at_dp1": r1["value"],
+        "per_chip_at_dpN": rn["value"],
+        "scaling_efficiency": _scaling_efficiency(r1["value"], rn["value"]),
+    }
 
 
 def main() -> None:
@@ -461,52 +747,65 @@ def main() -> None:
         return budget - (time.monotonic() - t_start)
 
     forced = os.environ.get("FLUXMPI_TPU_BENCH_CONFIG")
-    known = tuple(name for name, _ in _CONFIGS)
-    if forced and forced not in known:
+    if forced and forced not in _CHILD_FNS:
         raise SystemExit(
-            f"FLUXMPI_TPU_BENCH_CONFIG={forced!r} unknown; pick one of {known}"
+            f"FLUXMPI_TPU_BENCH_CONFIG={forced!r} unknown; "
+            f"pick one of {tuple(_CHILD_FNS)}"
         )
     platform = os.environ.get("FLUXMPI_TPU_BENCH_PLATFORM") or None
     timeout_override = os.environ.get("FLUXMPI_TPU_BENCH_TIMEOUT")
 
     if forced:
         # A forced config never consults the probe — run it directly.
-        plan = [(forced, dict(_CONFIGS)[forced], platform)]
-        for config, child_to, child_platform in plan:
-            result = _run_child(
-                config,
-                float(timeout_override) if timeout_override else child_to,
-                child_platform,
-            )
-            if result is not None:
-                print(json.dumps(result))
-                return
+        child_to = float(timeout_override) if timeout_override else dict(
+            _CONFIGS
+        ).get(forced, 300.0)
+        result = _run_child(forced, child_to, platform)
+        if result is not None:
+            print(json.dumps(result))
+            return
         print(json.dumps({"metric": "bench_failed", "value": 0.0,
                           "unit": "none", "vs_baseline": 0.0}))
         return
 
-    # Phase 1: probe the accelerator, with backoff — round 1 died because a
-    # hung jax.devices() ate the whole driver budget before any fallback ran.
+    # Phase 1: probe the accelerator — platform variants × timeouts with
+    # backoff, every attempt recorded for the output JSON. Round 1 died
+    # because a hung jax.devices() ate the whole driver budget; round 2
+    # never tried a platform variant after the env default failed.
     probe = None
-    for attempt, probe_to in enumerate(_PROBE_TIMEOUTS):
+    probe_attempts: list[dict] = []
+    timeouts = _probe_timeouts()
+    for attempt, probe_to in enumerate(timeouts):
         if remaining() < probe_to + 200:
             break
-        probe = _run_probe(probe_to, platform)
+        variant = _PROBE_PLATFORMS[min(attempt, len(_PROBE_PLATFORMS) - 1)]
+        if platform is not None:
+            variant = platform  # explicit pin wins every attempt
+        probe = _run_probe(probe_to, variant, probe_attempts)
         if probe is not None:
             break
-        if attempt < len(_PROBE_TIMEOUTS) - 1:
+        if attempt < len(timeouts) - 1:
             time.sleep(min(10 * (attempt + 1), 30))
     accel_ok = probe is not None and probe.get("platform") != "cpu"
     if probe is None:
         print("bench: accelerator never came up; CPU fallback", file=sys.stderr)
+    probe_platform = None
+    if accel_ok:
+        # Whatever variant succeeded is what the workload children use.
+        for rec in probe_attempts:
+            if rec.get("ok"):
+                v = rec["platform_variant"]
+                probe_platform = None if v == "env-default" else v
+                break
 
     if accel_ok:
-        plan = [(name, to, platform) for name, to in _CONFIGS]
+        plan = [(name, to, probe_platform) for name, to in _CONFIGS]
         # Absolute last resort if every accelerator config fails: CPU mlp.
         plan.append(("mlp", 150.0, "cpu"))
     else:
         plan = [("mlp", 150.0, "cpu"), ("cnn", 300.0, "cpu")]
 
+    result = None
     for config, child_to, child_platform in plan:
         if timeout_override:
             child_to = float(timeout_override)
@@ -516,18 +815,32 @@ def main() -> None:
             break
         result = _run_child(config, child_to, child_platform)
         if result is not None:
-            print(json.dumps(result))
-            return
-    print(
-        json.dumps(
-            {
-                "metric": "bench_failed",
-                "value": 0.0,
-                "unit": "none",
-                "vs_baseline": 0.0,
-            }
+            break
+
+    if result is None:
+        result = {"metric": "bench_failed", "value": 0.0, "unit": "none",
+                  "vs_baseline": 0.0}
+    result["probe"] = {"attempts": probe_attempts}
+
+    # Phase 3: secondary metrics, budget permitting — never at the expense
+    # of the primary line.
+    if accel_ok and remaining() > 300 and result["metric"] != "bench_failed":
+        attn = _run_child(
+            "attention", min(360.0, remaining() - 60), probe_platform
         )
-    )
+        if attn is not None:
+            result["attention"] = {
+                k: attn[k] for k in ("value", "unit", "per_seq")
+                if k in attn
+            }
+    if remaining() > 120 and result["metric"] != "bench_failed":
+        scaling = _run_scaling(
+            remaining(), probe if accel_ok else None, probe_platform
+        )
+        if scaling is not None:
+            result["scaling"] = scaling
+
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -13,6 +13,13 @@ from .pipeline import (  # noqa: F401
     pipeline_rules,
     stack_stage_params,
 )
+from .ring import (  # noqa: F401
+    make_ring_attention,
+    ring_attention,
+    ring_attention_fn,
+    zigzag_indices,
+    zigzag_ring_attention,
+)
 from .sharding import (  # noqa: F401
     combine_rules,
     fsdp_rule,

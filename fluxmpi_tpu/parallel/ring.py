@@ -8,6 +8,23 @@ accumulates its queries' attention with a numerically-stable online softmax
 and the K/V transfer overlaps with the block computation, which is exactly
 the layout the TPU torus wants.
 
+Two causal schedules:
+
+- **contiguous** (:func:`ring_attention` with ``causal=True``): device i
+  owns sequence block i. Simple, but causally imbalanced — device 0 skips
+  all but one ring tick while device n-1 computes on every tick.
+- **zigzag** (:func:`zigzag_ring_attention`): the sequence is split into
+  ``2n`` chunks and device i owns chunks ``(i, 2n-1-i)``. Every device then
+  does exactly the same causal work on every tick (two half-size block
+  attends, or two diagonals plus one full on tick 0), recovering the
+  ~2× causal FLOP saving that the contiguous schedule wastes as idle slots.
+  Use :func:`zigzag_indices` to permute global arrays into this layout.
+
+Masking beyond ``causal`` uses the same integer segment-id convention as
+:mod:`fluxmpi_tpu.ops.flash_attention` (attend iff ids equal and key id
+nonzero; 0 = padding); K/V segment ids rotate around the ring with their
+blocks.
+
 The reference framework never touches the sequence dimension (SURVEY.md §5
 — DP-only); this module is the capability extension that makes long-context
 training first-class on TPU, designed so the ``sp`` axis composes with the
@@ -19,6 +36,8 @@ Shapes: ``q, k, v`` are ``(batch, seq_local, heads, head_dim)`` inside a
 
 from __future__ import annotations
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -26,7 +45,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .. import config
 from ._compat import shard_map_unchecked
 
-__all__ = ["ring_attention", "make_ring_attention", "ring_attention_fn"]
+__all__ = [
+    "ring_attention",
+    "zigzag_ring_attention",
+    "zigzag_indices",
+    "make_ring_attention",
+    "ring_attention_fn",
+]
 
 _NEG_INF = -1e30
 
@@ -35,13 +60,14 @@ def _block_attend(q, k, v, o, m, l, mask):
     """One blockwise online-softmax update.
 
     q: [b, sq, h, d]; k/v: [b, sk, h, d]; o: [b, sq, h, d];
-    m/l: [b, sq, h]; mask: [sq, sk] boolean (True = attend) or None.
+    m/l: [b, sq, h]; mask: bool broadcastable to [b, h, sq, sk]
+    (True = attend) or None.
     """
     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
     # scores: [b, h, sq, sk] — contraction on head_dim, batched on (b, h)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if mask is not None:
-        scores = jnp.where(mask[None, None, :, :], scores, _NEG_INF)
+        scores = jnp.where(mask, scores, _NEG_INF)
     m_block = jnp.max(scores, axis=-1)  # [b, h, sq]
     m_block = jnp.moveaxis(m_block, 1, -1)  # [b, sq, h]
     m_new = jnp.maximum(m, m_block)
@@ -49,7 +75,7 @@ def _block_attend(q, k, v, o, m, l, mask):
     alpha = jnp.exp(m - m_new)  # [b, sq, h]
     p = jnp.exp(scores - jnp.moveaxis(m_new, -1, 1)[:, :, :, None])  # [b,h,sq,sk]
     if mask is not None:
-        p = jnp.where(mask[None, None, :, :], p, 0.0)
+        p = jnp.where(mask, p, 0.0)
     l_new = l * alpha + jnp.moveaxis(jnp.sum(p, axis=-1), 1, -1)
     o_new = o * alpha[..., None] + jnp.moveaxis(
         jnp.einsum("bhqk,bkhd->bhqd", p, v), 1, 2
@@ -57,7 +83,51 @@ def _block_attend(q, k, v, o, m, l, mask):
     return o_new, m_new, l_new
 
 
-def _ring_flash(q, k, v, *, name: str, causal: bool, n: int, idx):
+def _seg_mask4(qseg, kseg):
+    """Segment mask broadcastable to [b, h, sq, sk]: attend iff same segment
+    and the key is not padding (id 0)."""
+    q4 = qseg[:, None, :, None]
+    k4 = kseg[:, None, None, :]
+    return (q4 == k4) & (k4 != 0)
+
+
+def _dense_with_lse(q, k, v, causal):
+    """Dense local attend returning (normalized out [b,sq,h,d] f32,
+    lse [b,h,sq] f32) — the non-Pallas twin of flash_attention_with_lse,
+    used by the zigzag schedule's CPU/debug path."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk",
+        q.astype(jnp.float32),
+        k.astype(jnp.float32),
+    ) * scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1)  # [b, h, sq]
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    lse = m + jnp.log(jnp.where(l == 0.0, 1.0, l))
+    o = jnp.einsum("bhqk,bkhd->bqhd", p / l[..., None], v.astype(jnp.float32))
+    return o, lse
+
+
+def _lse_merge(o, lse, o_blk, lse_blk):
+    """Merge an accumulated (o [b,sq,h,d] f32, lse [b,sq,h]) with a new
+    normalized block result whose lse arrives as [b, h, sq] (the kernel
+    convention)."""
+    lse_blk = jnp.moveaxis(lse_blk, 1, -1)
+    lse_new = jnp.logaddexp(lse, lse_blk)
+    w_prev = jnp.exp(lse - lse_new)[..., None]
+    w_blk = jnp.exp(lse_blk - lse_new)[..., None]
+    return o * w_prev + o_blk.astype(jnp.float32) * w_blk, lse_new
+
+
+def _ring_flash(
+    q, k, v, *, name: str, causal: bool, n: int, idx, qseg, kseg,
+    block_q: int | None, block_k: int | None
+):
     """Ring accumulation with the Pallas flash kernel as the local block
     attend (:func:`fluxmpi_tpu.ops.flash_attention_with_lse`).
 
@@ -72,28 +142,28 @@ def _ring_flash(q, k, v, *, name: str, causal: bool, n: int, idx):
     o = jnp.zeros((b, sq, h, d), dtype=jnp.float32)
     lse = jnp.full((b, sq, h), _NEG_INF, dtype=jnp.float32)
     perm = [(i, (i + 1) % n) for i in range(n)]
+    has_seg = qseg is not None
 
-    def merge(o, lse, o_blk, lse_blk):
-        # lse_blk arrives as (b, h, sq) from the kernel.
-        lse_blk = jnp.moveaxis(lse_blk, 1, -1)
-        lse_new = jnp.logaddexp(lse, lse_blk)
-        w_prev = jnp.exp(lse - lse_new)[..., None]
-        w_blk = jnp.exp(lse_blk - lse_new)[..., None]
-        return o * w_prev + o_blk.astype(jnp.float32) * w_blk, lse_new
+    def attend(k_blk, v_blk, kseg_blk, local_causal):
+        seg = (qseg, kseg_blk) if has_seg else None
+        return flash_attention_with_lse(
+            q, k_blk, v_blk, causal=local_causal, segment_ids=seg,
+            block_q=block_q, block_k=block_k
+        )
 
     def body(s, carry):
-        o, lse, k_blk, v_blk = carry
+        o, lse, k_blk, v_blk, kseg_blk = carry
         # After s rotations, the resident block originated on ring position
         # (idx - s) mod n.
         src = (idx - s) % n
 
         def full_blk(_):
-            return flash_attention_with_lse(q, k_blk, v_blk, causal=False)
+            return attend(k_blk, v_blk, kseg_blk, False)
 
         if causal:
             def diag_blk(_):
                 # Same ring position: global offsets cancel, local causal.
-                return flash_attention_with_lse(q, k_blk, v_blk, causal=True)
+                return attend(k_blk, v_blk, kseg_blk, True)
 
             def skip_blk(_):
                 return (
@@ -110,13 +180,27 @@ def _ring_flash(q, k, v, *, name: str, causal: bool, n: int, idx):
         else:
             o_blk, lse_blk = full_blk(None)
 
-        o2, lse2 = merge(o, lse, o_blk, lse_blk)
+        o2, lse2 = _lse_merge(o, lse, o_blk, lse_blk)
         k_next = jax.lax.ppermute(k_blk, name, perm)
         v_next = jax.lax.ppermute(v_blk, name, perm)
-        return o2, lse2, k_next, v_next
+        kseg_next = (
+            jax.lax.ppermute(kseg_blk, name, perm) if has_seg else kseg_blk
+        )
+        return o2, lse2, k_next, v_next, kseg_next
 
-    o, lse, _, _ = jax.lax.fori_loop(0, n, body, (o, lse, k, v))
+    kseg0 = kseg if has_seg else jnp.zeros((), jnp.int32)
+    o, lse, _, _, _ = jax.lax.fori_loop(0, n, body, (o, lse, k, v, kseg0))
     return o.astype(q.dtype)
+
+
+def _normalize_ring_segments(segment_ids, b, sq, sk):
+    """Ring spelling of the flash kernel's segment normalization — shapes
+    are the *local shards* ``(batch, seq_local)``; validation is shared
+    with :mod:`fluxmpi_tpu.ops.flash_attention` so the two paths cannot
+    drift."""
+    from ..ops.flash_attention import _normalize_segments
+
+    return _normalize_segments(segment_ids, b, sq, sk)
 
 
 def ring_attention(
@@ -126,7 +210,10 @@ def ring_attention(
     *,
     axis_name: str | None = None,
     causal: bool = False,
+    segment_ids=None,
     use_flash: bool = False,
+    block_q: int | None = None,
+    block_k: int | None = None,
 ) -> jnp.ndarray:
     """Blockwise ring attention; call inside ``shard_map`` with the sequence
     dimension of q/k/v sharded over ``axis_name``.
@@ -134,20 +221,31 @@ def ring_attention(
     Each of the ``n`` ring steps attends local queries to the K/V block
     currently resident, then rotates K/V to the next ring neighbor. With
     ``causal=True``, blocks strictly in the future are skipped via a zero
-    mask (compiled as a select — no dynamic control flow).
+    mask (compiled as a select — no dynamic control flow); for balanced
+    causal work use :func:`zigzag_ring_attention` instead.
+
+    ``segment_ids``: optional int32 local shards ``[batch, seq_local]`` (or
+    a ``(q_seg, kv_seg)`` pair) in the flash-kernel convention — attend iff
+    ids equal and key id nonzero, 0 = padding. K/V ids rotate with their
+    blocks.
 
     ``use_flash=True`` swaps the dense local block attend for the Pallas
     flash kernel (memory-optimal on-chip: the [sq, sk] score block never
-    leaves VMEM); local sequence lengths must then divide the kernel's block
-    sizes.
+    leaves VMEM); local sequence lengths must then divide ``block_q`` /
+    ``block_k`` (both threaded to the kernel — tune for shards smaller
+    than 128).
     """
     name = axis_name or config.SP_AXIS_NAME
     n = jax.lax.axis_size(name)
     idx = jax.lax.axis_index(name)
     b, sq, h, d = q.shape
+    qseg, kseg = _normalize_ring_segments(segment_ids, b, sq, k.shape[1])
 
     if use_flash:
-        return _ring_flash(q, k, v, name=name, causal=causal, n=n, idx=idx)
+        return _ring_flash(
+            q, k, v, name=name, causal=causal, n=n, idx=idx,
+            qseg=qseg, kseg=kseg, block_q=block_q, block_k=block_k,
+        )
 
     o = jnp.zeros_like(q, dtype=jnp.float32)
     m = jnp.full((b, sq, h), _NEG_INF, dtype=jnp.float32)
@@ -155,35 +253,177 @@ def ring_attention(
 
     qf = q.astype(jnp.float32)
     perm = [(i, (i + 1) % n) for i in range(n)]
+    has_seg = qseg is not None
 
     def body(s, carry):
-        o, m, l, k_blk, v_blk = carry
+        o, m, l, k_blk, v_blk, kseg_blk = carry
         # After s rotations, the resident block originated on ring position
         # (idx - s) mod n.
         src = (idx - s) % n
         kf = k_blk.astype(jnp.float32)
         vf = v_blk.astype(jnp.float32)
+        mask = None
         if causal:
             q_pos = idx * sq + jnp.arange(sq)
             k_pos = src * k_blk.shape[1] + jnp.arange(k_blk.shape[1])
-            mask = q_pos[:, None] >= k_pos[None, :]
-        else:
-            mask = None
+            mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
+        if has_seg:
+            smask = _seg_mask4(qseg, kseg_blk)
+            mask = smask if mask is None else jnp.logical_and(mask, smask)
         o2, m2, l2 = _block_attend(qf, kf, vf, o, m, l, mask)
         k_next = jax.lax.ppermute(k_blk, name, perm)
         v_next = jax.lax.ppermute(v_blk, name, perm)
-        return o2, m2, l2, k_next, v_next
+        kseg_next = (
+            jax.lax.ppermute(kseg_blk, name, perm) if has_seg else kseg_blk
+        )
+        return o2, m2, l2, k_next, v_next, kseg_next
 
-    o, m, l, _, _ = jax.lax.fori_loop(0, n, body, (o, m, l, k, v))
+    kseg0 = kseg if has_seg else jnp.zeros((), jnp.int32)
+    o, m, l, _, _, _ = jax.lax.fori_loop(0, n, body, (o, m, l, k, v, kseg0))
     # Guard fully-masked rows (l == 0) against 0/0.
     l = jnp.where(l == 0.0, 1.0, l)
     return (o / l[..., None]).astype(q.dtype)
+
+
+def zigzag_tick_work(i: int, s: int, n: int) -> tuple[tuple[str, str, str], ...]:
+    """The zigzag schedule as data: the chunk attends device ``i`` performs
+    on tick ``s`` of an ``n``-device ring, as ``(q_chunk, kv_chunk, kind)``
+    triples with chunks named ``"lo"``/``"hi"`` and kind ``"full"`` or
+    ``"diag"`` (diag ≈ half the FLOPs of full). This is the single source of
+    truth the implementation mirrors (tick 0 literally; ticks ≥ 1 via the
+    src</> predicates) and the balance test audits."""
+    src = (i - s) % n
+    if s == 0:
+        return (("lo", "lo", "diag"), ("hi", "lo", "full"), ("hi", "hi", "diag"))
+    if src < i:
+        return (("hi", "lo", "full"), ("lo", "lo", "full"))
+    return (("hi", "lo", "full"), ("hi", "hi", "full"))
+
+
+def zigzag_indices(seq_len: int, n: int) -> np.ndarray:
+    """Permutation taking a contiguous global sequence to zigzag layout:
+    split into ``2n`` chunks, device i owns chunks ``(i, 2n-1-i)``. Apply as
+    ``x[:, zigzag_indices(s, n)]`` before the sharded call; invert with
+    ``jnp.argsort`` of the same indices."""
+    if seq_len % (2 * n):
+        raise ValueError(
+            f"sequence length {seq_len} must be divisible by 2·n = {2 * n}"
+        )
+    c = seq_len // (2 * n)
+    chunks = np.arange(seq_len).reshape(2 * n, c)
+    order = []
+    for i in range(n):
+        order += [i, 2 * n - 1 - i]
+    return chunks[order].reshape(-1)
+
+
+def zigzag_ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str | None = None,
+    use_flash: bool = False,
+    block_q: int | None = None,
+    block_k: int | None = None,
+) -> jnp.ndarray:
+    """Causal ring attention with the zigzag-balanced schedule; call inside
+    ``shard_map`` on arrays pre-permuted with :func:`zigzag_indices`.
+
+    Device i holds global chunks ``(i, 2n-1-i)`` concatenated along the
+    sequence axis. Per ring tick every device performs exactly two
+    half-block attends (tick 0: two causal diagonals plus one full), so no
+    device ever idles — unlike the contiguous causal schedule where device 0
+    skips n-1 of its n ticks. Total work is the causal ideal, half of the
+    non-causal ring. Schedule spec: :func:`zigzag_tick_work`.
+
+    Segment masking is not supported here (the chunk permutation would also
+    permute segment boundaries); use :func:`ring_attention` for packed or
+    padded batches.
+    """
+    from ..ops.flash_attention import flash_attention_with_lse
+
+    name = axis_name or config.SP_AXIS_NAME
+    n = jax.lax.axis_size(name)
+    idx = jax.lax.axis_index(name)
+    b, sq, h, d = q.shape
+    if sq % 2:
+        raise ValueError(f"local sequence length {sq} must be even (2 chunks)")
+    c = sq // 2
+
+    def attend(qc, kc, vc, local_causal):
+        if use_flash:
+            return flash_attention_with_lse(
+                qc, kc, vc, causal=local_causal,
+                block_q=None if block_q is None else min(block_q, c),
+                block_k=None if block_k is None else min(block_k, c),
+            )
+        return _dense_with_lse(qc, kc, vc, local_causal)
+
+    def split(t):
+        return t[:, :c], t[:, c:]
+
+    q_lo, q_hi = split(q)
+
+    o_lo = jnp.zeros((b, c, h, d), jnp.float32)
+    o_hi = jnp.zeros((b, c, h, d), jnp.float32)
+    lse_lo = jnp.full((b, c, h), _NEG_INF, jnp.float32)
+    lse_hi = jnp.full((b, c, h), _NEG_INF, jnp.float32)
+
+    # Tick 0 — resident KV is our own pair: zigzag_tick_work(i, 0, n).
+    kv_lo_k, kv_hi_k = split(k)
+    kv_lo_v, kv_hi_v = split(v)
+    o_blk, lse_blk = attend(q_lo, kv_lo_k, kv_lo_v, True)  # (lo, lo, diag)
+    o_lo, lse_lo = _lse_merge(o_lo, lse_lo, o_blk, lse_blk)
+    o_blk, lse_blk = attend(q_hi, kv_lo_k, kv_lo_v, False)  # (hi, lo, full)
+    o_hi, lse_hi = _lse_merge(o_hi, lse_hi, o_blk, lse_blk)
+    o_blk, lse_blk = attend(q_hi, kv_hi_k, kv_hi_v, True)  # (hi, hi, diag)
+    o_hi, lse_hi = _lse_merge(o_hi, lse_hi, o_blk, lse_blk)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(s, carry):
+        o_lo, lse_lo, o_hi, lse_hi, k_blk, v_blk = carry
+        k_blk = jax.lax.ppermute(k_blk, name, perm)
+        v_blk = jax.lax.ppermute(v_blk, name, perm)
+        src = (idx - s) % n
+        klo, khi = split(k_blk)
+        vlo, vhi = split(v_blk)
+
+        # Always: (hi, lo, full) — q_hi = chunk 2n-1-idx is in the future of
+        # every lo chunk src < n.
+        o_blk, lse_blk = attend(q_hi, klo, vlo, False)
+        o_hi, lse_hi = _lse_merge(o_hi, lse_hi, o_blk, lse_blk)
+
+        # Predicate-selected second attend: src < idx → (lo, lo, full);
+        # src > idx → (hi, hi, full). Operands and target slot switch
+        # together; cost is identical on both sides so every device does the
+        # same work per tick (zigzag_tick_work).
+        pred = src < idx
+        q_sel = jnp.where(pred, q_lo, q_hi)
+        k_sel = jnp.where(pred, klo, khi)
+        v_sel = jnp.where(pred, vlo, vhi)
+        o_blk, lse_blk = attend(q_sel, k_sel, v_sel, False)
+        new_lo = _lse_merge(o_lo, lse_lo, o_blk, lse_blk)
+        new_hi = _lse_merge(o_hi, lse_hi, o_blk, lse_blk)
+        o_lo = jnp.where(pred, new_lo[0], o_lo)
+        lse_lo = jnp.where(pred, new_lo[1], lse_lo)
+        o_hi = jnp.where(pred, o_hi, new_hi[0])
+        lse_hi = jnp.where(pred, lse_hi, new_hi[1])
+        return o_lo, lse_lo, o_hi, lse_hi, k_blk, v_blk
+
+    o_lo, lse_lo, o_hi, lse_hi, _, _ = jax.lax.fori_loop(
+        1, n, body, (o_lo, lse_lo, o_hi, lse_hi, k, v)
+    )
+    return jnp.concatenate([o_lo, o_hi], axis=1).astype(q.dtype)
 
 
 def ring_attention_fn(
     axis_name: str | None = None,
     causal: bool = False,
     use_flash: bool = False,
+    block_q: int | None = None,
+    block_k: int | None = None,
 ):
     """An ``attention_fn`` drop-in for ``nn.MultiHeadDotProductAttention``.
 
@@ -192,7 +432,9 @@ def ring_attention_fn(
     every other encoder op (LayerNorm, MLP, residuals) is pointwise over the
     sequence, so only attention needs the ring. Explicit masks are not
     supported (use ``causal=True`` for causal masking; the mask is derived
-    from global ring positions).
+    from global ring positions). ``block_q``/``block_k`` thread to the flash
+    kernel — set them to divisors of the local sequence shard when it is
+    smaller than 128.
 
     Initialize parameters with a dense twin of the module (same config
     minus ``attention_fn`` — the parameter tree is identical) or inside the
@@ -208,7 +450,7 @@ def ring_attention_fn(
             )
         return ring_attention(
             query, key, value, axis_name=axis_name, causal=causal,
-            use_flash=use_flash,
+            use_flash=use_flash, block_q=block_q, block_k=block_k,
         )
 
     return fn
@@ -221,24 +463,45 @@ def make_ring_attention(
     causal: bool = False,
     batch_axis_name: str | None = None,
     use_flash: bool = False,
+    schedule: str = "contiguous",
+    block_q: int | None = None,
+    block_k: int | None = None,
 ):
     """Wrap :func:`ring_attention` for eager use on mesh-sharded arrays.
 
     Returns ``fn(q, k, v) -> out`` where the inputs' sequence dimension
     (axis 1) is laid out over ``axis_name`` (and optionally batch over
     ``batch_axis_name``). Compiled once per shape.
+
+    ``schedule="zigzag"`` (causal only) applies the :func:`zigzag_indices`
+    permutation on the way in and its inverse on the way out, so callers
+    keep contiguous global sequences while the devices run the balanced
+    schedule.
     """
     from ..runtime import global_mesh
+
+    if schedule not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown schedule {schedule!r}")
+    if schedule == "zigzag" and not causal:
+        raise ValueError("zigzag schedule only applies to causal attention")
 
     mesh = mesh or global_mesh()
     sp = axis_name or config.SP_AXIS_NAME
     dp = batch_axis_name
     spec = P(dp, sp)
 
-    def body(q, k, v):
-        return ring_attention(
-            q, k, v, axis_name=sp, causal=causal, use_flash=use_flash
-        )
+    if schedule == "zigzag":
+        def body(q, k, v):
+            return zigzag_ring_attention(
+                q, k, v, axis_name=sp, use_flash=use_flash,
+                block_q=block_q, block_k=block_k,
+            )
+    else:
+        def body(q, k, v):
+            return ring_attention(
+                q, k, v, axis_name=sp, causal=causal, use_flash=use_flash,
+                block_q=block_q, block_k=block_k,
+            )
 
     mapped = shard_map_unchecked(
         body, mesh, in_specs=(spec, spec, spec), out_specs=spec
@@ -247,13 +510,22 @@ def make_ring_attention(
 
     def fn(q, k, v):
         size = mesh.shape[sp]
+        divisor = 2 * size if schedule == "zigzag" else size
         for name_, t in (("q", q), ("k", k), ("v", v)):
-            if t.shape[1] % size != 0:
+            if t.shape[1] % divisor != 0:
                 raise ValueError(
                     f"{name_} sequence length {t.shape[1]} must be divisible "
-                    f"by the '{sp}' mesh axis size {size} (pad the sequence)"
+                    f"by {divisor} ('{sp}' axis size {size}"
+                    + (", ×2 chunks for zigzag)" if schedule == "zigzag"
+                       else ") — pad the sequence")
                 )
         sharding = NamedSharding(mesh, spec)
+        if schedule == "zigzag":
+            idxs = zigzag_indices(q.shape[1], size)
+            inv = np.argsort(idxs)
+            q, k, v = (jnp.asarray(t)[:, idxs] for t in (q, k, v))
+            q, k, v = (jax.device_put(t, sharding) for t in (q, k, v))
+            return jitted(q, k, v)[:, inv]
         q, k, v = (jax.device_put(t, sharding) for t in (q, k, v))
         return jitted(q, k, v)
 

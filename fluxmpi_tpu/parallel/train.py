@@ -195,13 +195,24 @@ def make_train_step(
 
     if style == "auto":
 
+        # With an FSDP/TP state layout, pin the gradients to the parameter
+        # shardings right at the grad/update boundary: the partitioner then
+        # owns a sharded-output reduction (reduce-scatter on TPU) instead of
+        # being free to keep full gradients replicated.
+        param_shardings = getattr(state_sharding, "params", None)
+
+        def _pin_grads(grads):
+            if param_shardings is None:
+                return grads
+            return jax.lax.with_sharding_constraint(grads, param_shardings)
+
         if grad_accum_steps == 1:
 
             def step(ts: TrainState, batch):
                 (loss, new_mstate), grads = grad_and_aux(
                     ts.params, ts.model_state, batch
                 )
-                return _apply_update(ts, grads, loss, new_mstate)
+                return _apply_update(ts, _pin_grads(grads), loss, new_mstate)
 
         else:
 
@@ -231,7 +242,7 @@ def make_train_step(
                     body, (zeros, jnp.zeros(()), ts.model_state), micro
                 )
                 grads = jax.tree_util.tree_map(lambda x: x / k, g)
-                return _apply_update(ts, grads, l / k, ms)
+                return _apply_update(ts, _pin_grads(grads), l / k, ms)
 
         replicated = NamedSharding(mesh, P())
         state_in = replicated if state_sharding is None else state_sharding

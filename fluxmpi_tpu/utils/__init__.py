@@ -1,4 +1,8 @@
 """Auxiliary subsystems: checkpointing, profiling, pytree helpers."""
 
-from .checkpoint import restore_checkpoint, save_checkpoint  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    CheckpointManager,
+    restore_checkpoint,
+    save_checkpoint,
+)
 from .profiling import profile_trace, step_timer  # noqa: F401

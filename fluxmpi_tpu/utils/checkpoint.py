@@ -20,6 +20,10 @@ first-class pair with two layouts handled transparently:
 from __future__ import annotations
 
 import os
+import re
+import shutil
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any
 
 import jax
@@ -27,13 +31,33 @@ import numpy as np
 
 from ..sync import synchronize
 
-__all__ = ["save_checkpoint", "restore_checkpoint"]
+__all__ = ["save_checkpoint", "restore_checkpoint", "CheckpointManager"]
 
 
 def _checkpointer():
     import orbax.checkpoint as ocp
 
     return ocp.PyTreeCheckpointer()
+
+
+def _process_barrier(name: str) -> None:
+    """Cross-process barrier over the coordination service — NOT a device
+    collective. CheckpointManager runs saves on a background thread; a
+    device collective there could be submitted in a different order than
+    the main thread's train-step collectives on another process, and JAX
+    multi-controller deadlocks on submission-order inversion. The
+    coordination-service barrier has no device program, so thread timing
+    cannot invert anything."""
+    if jax.process_count() <= 1:
+        return
+    try:  # pragma: no cover - multihost only
+        from orbax.checkpoint import multihost
+
+        multihost.sync_global_processes(name)
+    except Exception:  # pragma: no cover - very old orbax
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
 
 
 def _is_sharded_tree(tree: Any) -> bool:
@@ -127,10 +151,7 @@ def save_checkpoint(path: str, state: Any, *, force: bool = True) -> None:
         )
         _checkpointer().save(path, host_state, force=force)
         _write_layout_marker(path, "replicated")
-    if jax.process_count() > 1:  # pragma: no cover - multihost only
-        from jax.experimental import multihost_utils
-
-        multihost_utils.sync_global_devices(f"ckpt_save:{path}")
+    _process_barrier(f"ckpt_save:{path}")
 
 
 def restore_checkpoint(path: str, like: Any, *, root_rank: int = 0) -> Any:
@@ -189,3 +210,149 @@ def restore_checkpoint(path: str, like: Any, *, root_rank: int = 0) -> Any:
         return r
 
     return jax.tree_util.tree_map(_place, synced, like)
+
+
+_STEP_DIR_RE = re.compile(r"^step_(\d{8})$")
+
+
+class CheckpointManager:
+    """Training-run checkpoint lifecycle on top of
+    :func:`save_checkpoint`/:func:`restore_checkpoint` (VERDICT r2 next #7;
+    the reference leaves all of this user-land, SURVEY.md §5
+    "checkpoint/resume": ABSENT):
+
+    - **step-numbered directories** ``<dir>/step_00000042`` — the layout
+      marker the core writes *after* a save completes doubles as the commit
+      marker, so a torn save is never listed as restorable;
+    - **keep-k retention** (``max_to_keep``), oldest deleted after each
+      successful save, lead process only;
+    - **async save** (``async_save=True``): replicated state is snapshotted
+      to host up front (donation-safe), then written on a single background
+      thread (order preserved; each entry point waits for the previous
+      save); sharded state always saves synchronously (collective);
+      :meth:`wait_until_finished` joins;
+    - **resume discovery**: :meth:`latest_step` / :meth:`restore` with
+      ``step=None`` find the newest complete checkpoint.
+
+    All methods must be called on every process (saves/restores of sharded
+    state are collective).
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        max_to_keep: int | None = 3,
+        async_save: bool = True,
+    ):
+        self.directory = os.path.abspath(directory)
+        self.max_to_keep = max_to_keep
+        os.makedirs(self.directory, exist_ok=True)
+        self._executor = (
+            ThreadPoolExecutor(max_workers=1, thread_name_prefix="ckpt")
+            if async_save
+            else None
+        )
+        self._pending: Future | None = None
+        self._lock = threading.Lock()
+
+    def _step_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step:08d}")
+
+    def all_steps(self) -> list[int]:
+        """Steps with *complete* checkpoints (layout marker present),
+        ascending."""
+        steps = []
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return []
+        for name in names:
+            m = _STEP_DIR_RE.match(name)
+            if m and _read_layout_marker(
+                os.path.join(self.directory, name)
+            ) is not None:
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def latest_step(self) -> int | None:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def save(self, step: int, state: Any, *, force: bool = True) -> None:
+        """Checkpoint ``state`` as ``step``; with ``async_save`` only the
+        disk write runs in the background.
+
+        Replicated state is snapshotted to host *synchronously* first:
+        compiled train steps donate their input buffers by default, so the
+        caller's next ``step(state, …)`` would tear the device buffers out
+        from under a background ``device_get``. Sharded (FSDP/TP) state
+        cannot be host-snapshotted without gathering, so its save runs
+        synchronously (orbax still writes only per-process shards)."""
+        if self._executor is None or _is_sharded_tree(state):
+            self.wait_until_finished()
+            self._save_and_retain(step, state, force)
+            return
+        snapshot = jax.tree_util.tree_map(
+            lambda x: np.asarray(jax.device_get(x))
+            if isinstance(x, (jax.Array, np.ndarray))
+            else x,
+            state,
+        )
+        with self._lock:
+            prev = self._pending
+            if prev is not None:
+                prev.result()  # surface errors; keep cross-process order
+            self._pending = self._executor.submit(
+                self._save_and_retain, step, snapshot, force
+            )
+
+    def _save_and_retain(self, step: int, state: Any, force: bool) -> None:
+        save_checkpoint(self._step_path(step), state, force=force)
+        if self.max_to_keep is not None:
+            keep = set(self.all_steps()[-self.max_to_keep:])
+            keep.add(step)
+            if jax.process_index() == 0:
+                for s in self.all_steps():
+                    if s not in keep:
+                        path = self._step_path(s)
+                        # Marker first: once it is gone the step is
+                        # invisible to discovery even if the rmtree below
+                        # is interrupted.
+                        try:
+                            os.remove(_layout_marker_path(path))
+                        except FileNotFoundError:
+                            pass
+                        shutil.rmtree(path, ignore_errors=True)
+
+    def wait_until_finished(self) -> None:
+        """Block until any in-flight async save has committed."""
+        with self._lock:
+            pending = self._pending
+            self._pending = None
+        if pending is not None:
+            pending.result()
+
+    def restore(self, like: Any, *, step: int | None = None) -> tuple[int, Any]:
+        """Restore ``step`` (default: latest complete) as
+        ``(step, state)``; raises ``FileNotFoundError`` when nothing is
+        restorable."""
+        self.wait_until_finished()
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no complete checkpoint under {self.directory}"
+                )
+        return step, restore_checkpoint(self._step_path(step), like)
+
+    def close(self) -> None:
+        self.wait_until_finished()
+        if self._executor is not None:
+            self._executor.shutdown(wait=True)
+
+    def __enter__(self) -> "CheckpointManager":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,23 @@
+"""Shared dense-attention oracles for the test suite (single source — the
+segment-mask semantics must not drift between test files)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def dense_seg_attention(q, k, v, qseg, kseg, causal=False):
+    """Dense oracle with the kernel's segment semantics: attend iff ids
+    equal and key id nonzero. Fully-masked rows are garbage here (uniform
+    softmax) — compare valid rows only."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    mask = (qseg[:, :, None] == kseg[:, None, :]) & (kseg[:, None, :] != 0)
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        pos = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        mask = mask & pos[None]
+    s = jnp.where(mask[:, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)

@@ -196,6 +196,43 @@ if ckpt_dir:
         "sharded restore mismatch on some process"
     )
 
+    # --- CheckpointManager: mid-epoch resume across processes (VERDICT r2
+    # next #7). Train 2 steps checkpointing each, "crash", resume from the
+    # latest step on every process, train 1 more — the resumed world must
+    # agree bitwise with the uninterrupted one. ---
+    from fluxmpi_tpu.utils import CheckpointManager
+
+    mgr = CheckpointManager(
+        os.path.join(ckpt_dir, "manager"), max_to_keep=2, async_save=True
+    )
+    mstate = state
+    for i in range(2):
+        mstate, _ = step(mstate, batch)
+        mgr.save(i + 1, mstate)
+    mgr.wait_until_finished()
+    assert mgr.latest_step() == 2
+    # Template BEFORE the continuation step: the compiled step donates its
+    # input state, so mstate's buffers die inside it.
+    fresh_like = replicate(
+        jax.tree_util.tree_map(
+            lambda x: jnp.zeros_like(x) if isinstance(x, jax.Array) else x,
+            jax.device_get(mstate),
+        ),
+        mesh,
+    )
+    cont_state, cont_loss = step(mstate, batch)  # uninterrupted continuation
+    last, resumed = mgr.restore(fresh_like)
+    assert last == 2
+    resumed_state, resumed_loss = step(resumed, batch)
+    assert float(resumed_loss) == float(cont_loss), (
+        resumed_loss, cont_loss,
+    )
+    rspread = fm.host_allreduce(
+        np.asarray(float(resumed_loss)), op="max"
+    ) - fm.host_allreduce(np.asarray(float(resumed_loss)), op="min")
+    assert float(rspread) == 0.0, rspread
+    mgr.close()
+
 # --- ragged-shard loader lockstep ---
 # 14 samples over N procs: ceil partition gives the last rank a smaller
 # (or empty-padded) shard; every process must still yield the same number

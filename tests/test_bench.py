@@ -1,0 +1,97 @@
+"""Unit tests for bench.py's measurement machinery (VERDICT r2 weak #2:
+the MFU path must not be cold code that first executes on the TPU run)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import bench
+
+
+def test_chip_peak_flops_lookup():
+    assert bench._chip_peak_flops("TPU v5 lite") == 197e12
+    assert bench._chip_peak_flops("TPU v5e") == 197e12
+    assert bench._chip_peak_flops("TPU v4") == 275e12
+    assert bench._chip_peak_flops("TPU v6 lite") == 918e12
+    assert bench._chip_peak_flops("cpu") is None
+
+
+def test_mfu_math():
+    # 1e12 FLOPs/step at 98.5 steps/s on one v5e (197e12 peak) = 50%.
+    assert bench._mfu(1e12, 98.5, 1, "TPU v5 lite") == 0.5
+    # Per-chip normalization.
+    assert bench._mfu(2e12, 98.5, 2, "TPU v5 lite") == 0.5
+    # Unknown chip or missing FLOPs → None.
+    assert bench._mfu(1e12, 10.0, 1, "cpu") is None
+    assert bench._mfu(None, 10.0, 1, "TPU v5 lite") is None
+    assert bench._mfu(0.0, 10.0, 1, "TPU v5 lite") is None
+
+
+def test_mfu_discards_impossible_values():
+    # MFU > 1 means a broken clock or FLOPs estimate (round 2's first TPU
+    # number was 6.33): must be dropped, never reported.
+    assert bench._mfu(1e12, 1000.0, 1, "TPU v5 lite") is None
+
+
+def test_scaling_efficiency_math():
+    assert bench._scaling_efficiency(100.0, 85.0) == 0.85
+    assert bench._scaling_efficiency(0.0, 50.0) == 0.0
+
+
+def test_device_fingerprint_keys_cpu_by_core_count():
+    # ADVICE r2 #3: anchors from another machine must not be compared.
+    import os
+
+    assert bench._device_fingerprint("tpu", "TPU v5 lite") == "TPU v5 lite"
+    assert bench._device_fingerprint("cpu", "cpu") == f"cpu{os.cpu_count()}"
+
+
+def test_parse_json_line_takes_last_valid():
+    out = "garbage\n{\"a\": 1}\nnoise {\nfinal\n" + json.dumps(
+        {"metric": "m", "value": 2.0}
+    )
+    parsed = bench._parse_json_line(out)
+    assert parsed == {"metric": "m", "value": 2.0}
+    assert bench._parse_json_line("no json here") is None
+
+
+def test_steps_per_sec_slope_cancels_fixed_overhead():
+    # Synthetic step with a large fixed per-sync cost: the two-point slope
+    # must recover the true per-step rate (round 2's direct-timing number
+    # was 20× off through the tunnel).
+    class FakeClock:
+        def __init__(self):
+            self.t = 0.0
+
+    clock = FakeClock()
+    step_cost, sync_cost = 0.01, 0.5
+
+    def fake_step(state, data):
+        clock.t += step_cost
+        return state, None
+
+    real_sync = bench._sync
+    real_counter = bench.time.perf_counter
+    real_each = bench._sync_each_step
+    bench._sync = lambda x: setattr(clock, "t", clock.t + sync_cost)
+    bench.time.perf_counter = lambda: clock.t
+    # Model the TPU regime (one sync per measurement, async dispatch) —
+    # that is where the fixed cost must cancel; the CPU regime syncs every
+    # step to serialize collective launches.
+    bench._sync_each_step = lambda: False
+    try:
+        rate, _ = bench._steps_per_sec(fake_step, None, None, warmup=1, steps=20)
+    finally:
+        bench._sync = real_sync
+        bench.time.perf_counter = real_counter
+        bench._sync_each_step = real_each
+    assert rate == pytest.approx(1.0 / step_cost, rel=1e-6)
+
+
+def test_anchor_table_keyed_by_fingerprint():
+    key = ("resnet50_images_per_sec_per_chip", "tpu", "TPU v5 lite")
+    assert key in bench._ANCHORS
+    # No bare (metric, platform) keys left (every anchor carries a device
+    # fingerprint).
+    assert all(len(k) == 3 for k in bench._ANCHORS)

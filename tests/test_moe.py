@@ -174,3 +174,74 @@ def test_grouped_routing_explicit_groups(world):
 
     with _pytest.raises(ValueError, match="must divide token count"):
         bad.init(jax.random.PRNGKey(2), x)
+
+
+def test_ep_moe_lowers_to_all_to_all(world):
+    """VERDICT r2 next #4: the ep-sharded MoE step must MOVE TOKENS
+    (all-to-all over ep) rather than all-gather full expert weights onto
+    every device. The MoE layer's sharding pins (MoEMLP.mesh) force the
+    lowering; this guard keeps it pinned."""
+    import re
+
+    from fluxmpi_tpu.models import MoETransformerLM, expert_parallel_rules
+    from fluxmpi_tpu.parallel import (
+        TrainState,
+        combine_rules,
+        fsdp_rule,
+        make_train_step,
+        shard_tree,
+    )
+    from fluxmpi_tpu.parallel.train import shard_batch
+
+    mesh = _mesh({"dp": 2, "ep": 4})
+    num_experts, d_model, d_ff = 4, 32, 64
+    model = MoETransformerLM(
+        vocab_size=64, max_len=32, num_layers=1, d_model=d_model,
+        num_heads=4, d_ff=d_ff, num_experts=num_experts, mesh=mesh,
+    )
+    tokens = jnp.ones((8, 16), jnp.int32)
+    params = {
+        "params": model.init(jax.random.PRNGKey(0), tokens, train=False)["params"]
+    }
+    optimizer = optax.adam(1e-2)
+    rule = combine_rules(expert_parallel_rules(), fsdp_rule(mesh, min_size=512))
+    state, shardings = shard_tree(TrainState.create(params, optimizer), mesh, rule)
+
+    def loss_fn(p, mstate, batch):
+        bx, by = batch
+        logits, mutated = model.apply(p, bx, train=True, mutable=["losses"])
+        task = jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(logits, by)
+        )
+        aux = sum(jax.tree_util.tree_leaves(mutated["losses"]))
+        return task + 0.01 * aux, mstate
+
+    step = make_train_step(
+        loss_fn, optimizer, mesh=mesh, state_sharding=shardings,
+        batch_spec=P(("dp", "ep")), donate=False,
+    )
+    rng = np.random.default_rng(5)
+    batch = shard_batch(
+        (rng.integers(0, 64, size=(8, 16)).astype(np.int32),
+         rng.integers(0, 64, size=(8, 16)).astype(np.int32)),
+        mesh, spec=P(("dp", "ep")),
+    )
+    hlo = step.lower(state, batch).compile().as_text()
+
+    assert hlo.count("all-to-all") > 0, "EP einsums no longer lower to all-to-all"
+    # No all-gather may materialize a full expert weight stack
+    # [E, d_model, d_ff] / [E, d_ff, d_model] on any device.
+    full_shapes = (
+        f"[{num_experts},{d_model},{d_ff}]",
+        f"[{num_experts},{d_ff},{d_model}]",
+    )
+    gathers = re.findall(r"= \S+ all-gather\([^\n]*", hlo)
+    offenders = [g for g in gathers if any(s in g for s in full_shapes)]
+    assert not offenders, f"full expert-weight all-gather: {offenders[:2]}"
+
+    # And the step still trains.
+    losses = []
+    for _ in range(3):
+        state, loss = step(state, batch)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]

@@ -179,3 +179,212 @@ def test_transformer_trains_through_flash_attention(world):
     state, loss0 = step(state, data)
     state, loss1 = step(state, data)
     assert np.isfinite(float(loss0)) and float(loss1) < float(loss0)
+
+
+# ---- segment-id / padding masking (VERDICT r2 next #5) ----
+
+
+from _oracles import dense_seg_attention as _dense_seg  # noqa: E402
+
+
+def _packed_segments(b=2, s=64):
+    seg = np.zeros((b, s), np.int32)
+    seg[0, :16] = 1
+    seg[0, 16:48] = 2
+    seg[0, 48:] = 3
+    seg[1, :40] = 1
+    seg[1, 40:] = 2
+    return jnp.asarray(seg)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_segments_packed(world, causal):
+    # Packed-sequence masking: documents attend only within themselves.
+    from fluxmpi_tpu.ops import flash_attention
+
+    q, k, v = _qkv(seed=10)
+    seg = _packed_segments()
+    out = flash_attention(q, k, v, causal=causal, segment_ids=seg,
+                          block_q=16, block_k=16)
+    expected = _dense_seg(q, k, v, seg, seg, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expected), atol=2e-5
+    )
+
+
+def test_flash_segments_padding_rows_zero(world):
+    # Pad tokens (segment id 0) attend nothing and output exactly zero;
+    # valid rows are unaffected by the padding.
+    from fluxmpi_tpu.ops import flash_attention
+
+    q, k, v = _qkv(seed=11)
+    seg = np.ones((2, 64), np.int32)
+    seg[0, 48:] = 0
+    seg[1, 56:] = 0
+    seg = jnp.asarray(seg)
+    out = flash_attention(q, k, v, segment_ids=seg, block_q=16, block_k=16)
+    expected = _dense_seg(q, k, v, seg, seg)
+    valid = np.asarray(seg) != 0
+    np.testing.assert_allclose(
+        np.asarray(out)[valid], np.asarray(expected)[valid], atol=2e-5
+    )
+    assert np.all(np.asarray(out)[~valid] == 0.0)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_segments_grad_matches_dense(world, causal):
+    # Backward kernels under segment masking, padding included: grads match
+    # autodiff through the dense oracle when the loss reads valid rows only
+    # (the dense oracle's pad rows are garbage by construction).
+    from fluxmpi_tpu.ops import flash_attention
+
+    q, k, v = _qkv(seed=12)
+    seg = _packed_segments()
+    seg = seg.at[0, 56:].set(0)  # add a pad tail too
+    row_w = (seg != 0).astype(jnp.float32)[:, :, None, None]
+
+    def loss_flash(q, k, v):
+        out = flash_attention(q, k, v, causal=causal, segment_ids=seg,
+                              block_q=16, block_k=16)
+        return jnp.sum(jnp.sin(out) * row_w)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(jnp.sin(_dense_seg(q, k, v, seg, seg, causal)) * row_w)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_flash_fn_accepts_flax_padding_mask(world):
+    # flash_attention_fn honors nn.make_attention_mask-style padding masks
+    # (VERDICT r2 next #5: "accepting flax's padding mask instead of
+    # raising").
+    import flax.linen as nn
+
+    from fluxmpi_tpu.ops import flash_attention_fn
+
+    q, k, v = _qkv(seed=13)
+    valid = np.ones((2, 64), bool)
+    valid[0, 40:] = False
+    valid[1, 60:] = False
+    valid = jnp.asarray(valid)
+    mask = nn.make_attention_mask(valid, valid)  # [b, 1, sq, sk]
+
+    out = flash_attention_fn(block_q=16, block_k=16)(q, k, v, mask=mask)
+    seg = valid.astype(jnp.int32)
+    expected = _dense_seg(q, k, v, seg, seg)
+    ok = np.asarray(valid)
+    np.testing.assert_allclose(
+        np.asarray(out)[ok], np.asarray(expected)[ok], atol=2e-5
+    )
+
+
+def test_flash_fn_combined_causal_padding_mask(world):
+    # ADVICE r2 #1: causal=True with a combined causal∧padding mask must
+    # honor the padding component, not silently drop it.
+    import flax.linen as nn
+
+    from fluxmpi_tpu.ops import flash_attention_fn
+
+    q, k, v = _qkv(seed=14)
+    valid = np.ones((2, 64), bool)
+    valid[0, 32:] = False
+    valid = jnp.asarray(valid)
+    mask = nn.combine_masks(
+        nn.make_causal_mask(jnp.zeros((2, 64))),
+        nn.make_attention_mask(valid, valid),
+    )
+
+    out = flash_attention_fn(causal=True, block_q=16, block_k=16)(
+        q, k, v, mask=mask
+    )
+    seg = valid.astype(jnp.int32)
+    expected = _dense_seg(q, k, v, seg, seg, causal=True)
+    ok = np.asarray(valid)
+    np.testing.assert_allclose(
+        np.asarray(out)[ok], np.asarray(expected)[ok], atol=2e-5
+    )
+
+
+def test_flash_fn_rejects_bias(world):
+    from fluxmpi_tpu.ops import flash_attention_fn
+
+    q, k, v = _qkv(seed=15)
+    with pytest.raises(ValueError, match="bias"):
+        flash_attention_fn()(q, k, v, bias=jnp.zeros((2, 2, 64, 64)))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_fn_packed_sequence_mask(world, causal):
+    # Code-review r3: the flax packed-sequence idiom
+    # nn.make_attention_mask(seg, seg, jnp.equal) (block-diagonal) must be
+    # recovered EXACTLY — tokens must not attend across document
+    # boundaries.
+    import flax.linen as nn
+
+    from fluxmpi_tpu.ops import flash_attention_fn
+
+    q, k, v = _qkv(seed=16)
+    seg = _packed_segments()  # contiguous docs, no padding
+    mask = nn.make_attention_mask(seg, seg, jnp.equal)
+    if causal:
+        mask = nn.combine_masks(mask, nn.make_causal_mask(jnp.zeros((2, 64))))
+
+    out = flash_attention_fn(causal=causal, block_q=16, block_k=16)(
+        q, k, v, mask=mask
+    )
+    expected = _dense_seg(q, k, v, seg, seg, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expected), atol=2e-5
+    )
+
+
+def test_flash_fn_packed_plus_padding_mask(world):
+    # Packing AND a trailing pad, combined with causal — the full flax
+    # combine_masks stack.
+    import flax.linen as nn
+
+    from fluxmpi_tpu.ops import flash_attention_fn
+
+    q, k, v = _qkv(seed=17)
+    seg = np.zeros((2, 64), np.int32)
+    seg[0, :24] = 1
+    seg[0, 24:48] = 2  # then pad tail (0)
+    seg[1, :64] = 1
+    seg = jnp.asarray(seg)
+    valid = seg != 0
+    mask = nn.combine_masks(
+        nn.make_attention_mask(seg, seg, jnp.equal),
+        nn.make_attention_mask(valid, valid),
+        nn.make_causal_mask(jnp.zeros((2, 64))),
+    )
+    out = flash_attention_fn(causal=True, block_q=16, block_k=16)(
+        q, k, v, mask=mask
+    )
+    expected = _dense_seg(q, k, v, seg, seg, causal=True)
+    ok = np.asarray(valid)
+    np.testing.assert_allclose(
+        np.asarray(out)[ok], np.asarray(expected)[ok], atol=2e-5
+    )
+
+
+def test_flash_fn_poisons_unrepresentable_mask(world):
+    # Code-review r3 follow-up: a mask that segment ids cannot represent
+    # (e.g. a causal mask passed with causal=False) must NaN-poison the
+    # output — loud failure, never silently-wrong attention.
+    import flax.linen as nn
+
+    from fluxmpi_tpu.ops import flash_attention_fn
+
+    q, k, v = _qkv(seed=18)
+    causal_mask = nn.make_causal_mask(jnp.zeros((2, 64)))
+    out = flash_attention_fn(block_q=16, block_k=16)(q, k, v, mask=causal_mask)
+    assert np.all(np.isnan(np.asarray(out, dtype=np.float32)))
+
+    # …and a representable mask on the same path stays NaN-free.
+    valid = jnp.asarray(np.ones((2, 64), bool))
+    pad_mask = nn.make_attention_mask(valid, valid)
+    out = flash_attention_fn(block_q=16, block_k=16)(q, k, v, mask=pad_mask)
+    assert not np.any(np.isnan(np.asarray(out, dtype=np.float32)))

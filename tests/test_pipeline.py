@@ -236,3 +236,42 @@ def test_pipeline_output_sharded_over_pp(world):
     assert not y.is_fully_replicated
     shard_rows = {s.data.shape[0] for s in y.addressable_shards}
     assert shard_rows == {x.shape[0] // n_stages}
+
+
+def test_pipeline_input_sharded_over_pp(world):
+    """VERDICT r2 next #8: the input stream is pp-sharded too — the
+    compiled program wants x laid out over the pp axis (O(B/S) per device),
+    and grads through the feed ring stay exact vs a single-stage oracle."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from fluxmpi_tpu.parallel.pipeline import make_pipeline_fn, stack_stage_params
+
+    n_stages, d = 4, 8
+    mesh = _mesh_pp(n_stages)
+    params_list = _stages(n_stages, d, seed=11)
+    stacked = stack_stage_params(params_list)
+    x = jnp.asarray(
+        np.random.default_rng(12).normal(size=(8, d)).astype(np.float32)
+    )
+    fn = make_pipeline_fn(_stage_fn, mesh, n_microbatches=8)
+
+    # The compiled step consumes x sharded over pp, not replicated.
+    compiled = fn.lower(stacked, x).compile()
+    x_sharding = jax.tree_util.tree_leaves(compiled.input_shardings[0])[-1]
+    expected = NamedSharding(mesh, P("pp"))
+    assert x_sharding.is_equivalent_to(expected, x.ndim)
+
+    # Feed-ring forward and grads match the unpipelined composition.
+    def serial(params_list, x):
+        for p in params_list:
+            x = _stage_fn(p, x)
+        return x
+
+    np.testing.assert_allclose(
+        np.asarray(fn(stacked, x)), np.asarray(serial(params_list, x)),
+        rtol=2e-6, atol=2e-6,
+    )
+    gp = jax.grad(lambda xx: jnp.sum(jnp.sin(fn(stacked, xx))))(x)
+    gs = jax.grad(lambda xx: jnp.sum(jnp.sin(serial(params_list, xx))))(x)
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(gs),
+                               rtol=2e-5, atol=2e-6)

@@ -224,3 +224,62 @@ def test_fsdp_rule_min_size(world):
     assert rule("big/kernel", (64, 64)) == P("dp", None)
     # largest divisible dim wins
     assert rule("big/kernel", (64, 128)) == P(None, "dp")
+
+
+def test_fsdp_lowering_guard(world):
+    """VERDICT r2 next #4 (FSDP side): the compiled ZeRO-3 step must (a)
+    reduce gradients collectively (reduce-scatter on TPU; XLA's CPU
+    pipeline lacks the AR→RS rewrite, so all-reduce is the accepted CPU
+    spelling), (b) keep params AND optimizer moments sharded end-to-end in
+    its output layout, and (c) all-gather each sharded weight at most twice
+    (fwd + bwd re-gather) — never accumulate full-tree gathers."""
+    from fluxmpi_tpu.models import MLP
+    from fluxmpi_tpu.parallel import TrainState, fsdp_rule, make_train_step, shard_tree
+    from fluxmpi_tpu.parallel.train import shard_batch
+
+    mesh = _mesh(None, {"dp": 8})
+    model = MLP(features=(64, 64, 1))
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 2)))
+    optimizer = optax.adam(0.05)
+
+    def loss_fn(p, mstate, batch):
+        x, y = batch
+        return jnp.mean((model.apply(p, x) - y) ** 2), mstate
+
+    rule = fsdp_rule(mesh, min_size=16)
+    state, shardings = shard_tree(TrainState.create(params, optimizer), mesh, rule)
+    n_sharded = sum(
+        1 for s in jax.tree_util.tree_leaves(shardings.params)
+        if tuple(x for x in s.spec if x)
+    )
+    assert n_sharded >= 2
+
+    step = make_train_step(
+        loss_fn, optimizer, mesh=mesh, state_sharding=shardings, donate=False
+    )
+    rng = np.random.default_rng(1)
+    batch = shard_batch(
+        (rng.normal(size=(16, 2)).astype(np.float32),
+         rng.normal(size=(16, 1)).astype(np.float32)),
+        mesh,
+    )
+    compiled = step.lower(state, batch).compile()
+    hlo = compiled.as_text()
+
+    # (a) collective gradient reduction exists.
+    assert hlo.count("reduce-scatter") + hlo.count("all-reduce(") > 0
+
+    # (b) the OUTPUT state keeps the ZeRO layout: params and both Adam
+    # moments of every sharded kernel come back dp-sharded, not replicated.
+    out_state_shardings = compiled.output_shardings[0]
+    for tree in (out_state_shardings.params,
+                 out_state_shardings.opt_state[0].mu,
+                 out_state_shardings.opt_state[0].nu):
+        specs = [
+            tuple(x for x in s.spec if x)
+            for s in jax.tree_util.tree_leaves(tree)
+        ]
+        assert any(("dp",) == sp for sp in specs), specs
+
+    # (c) bounded weight re-gathers: ≤ 2 per sharded leaf.
+    assert hlo.count("all-gather(") <= 2 * n_sharded

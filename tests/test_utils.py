@@ -127,3 +127,162 @@ def test_checkpoint_layout_mismatch_raises(world, tmp_path):
     replicated_like = replicate(TrainState.create(params, opt), mesh)
     with pytest.raises(ValueError, match="sharded layout"):
         restore_checkpoint(path, replicated_like)
+
+
+def test_checkpoint_manager_lifecycle(world, tmp_path):
+    # VERDICT r2 next #7: step dirs, keep-k retention, resume discovery.
+    from fluxmpi_tpu.utils import CheckpointManager
+
+    state = {"w": jnp.arange(4.0), "step": jnp.zeros((), jnp.int32)}
+    mgr = CheckpointManager(str(tmp_path / "run"), max_to_keep=2,
+                            async_save=False)
+    assert mgr.latest_step() is None
+    for s in (1, 3, 7):
+        mgr.save(s, jax.tree_util.tree_map(lambda x: x + s, state))
+    assert mgr.all_steps() == [3, 7]  # keep-k dropped step 1
+    assert mgr.latest_step() == 7
+    step, restored = mgr.restore(state)
+    assert step == 7
+    np.testing.assert_allclose(
+        np.asarray(restored["w"]), np.arange(4.0) + 7
+    )
+    step, restored = mgr.restore(state, step=3)
+    assert step == 3
+    np.testing.assert_allclose(
+        np.asarray(restored["w"]), np.arange(4.0) + 3
+    )
+    with pytest.raises(FileNotFoundError):
+        CheckpointManager(str(tmp_path / "empty")).restore(state)
+
+
+def test_checkpoint_manager_async(world, tmp_path):
+    from fluxmpi_tpu.utils import CheckpointManager
+
+    state = {"w": jnp.arange(8.0)}
+    with CheckpointManager(str(tmp_path / "run"), max_to_keep=None) as mgr:
+        for s in range(4):
+            mgr.save(s, jax.tree_util.tree_map(lambda x: x * s, state))
+        mgr.wait_until_finished()
+        assert mgr.all_steps() == [0, 1, 2, 3]
+        step, restored = mgr.restore(state)
+        assert step == 3
+        np.testing.assert_allclose(np.asarray(restored["w"]),
+                                   np.arange(8.0) * 3)
+
+
+def test_checkpoint_manager_ignores_torn_save(world, tmp_path):
+    # A step directory without the layout marker (save died mid-write) must
+    # be invisible to discovery.
+    from fluxmpi_tpu.utils import CheckpointManager
+
+    state = {"w": jnp.arange(4.0)}
+    mgr = CheckpointManager(str(tmp_path / "run"), async_save=False)
+    mgr.save(5, state)
+    (tmp_path / "run" / "step_00000009").mkdir()  # torn: no marker
+    assert mgr.all_steps() == [5]
+    step, _ = mgr.restore(state)
+    assert step == 5
+
+
+def test_checkpoint_manager_resumes_training(world, tmp_path):
+    # Kill-and-resume equivalence: train 4 steps saving each, "crash",
+    # resume from latest, finish — states match an uninterrupted run.
+    import optax
+
+    import fluxmpi_tpu as fm
+    from fluxmpi_tpu.parallel import TrainState, make_train_step
+    from fluxmpi_tpu.parallel.train import replicate, shard_batch
+    from fluxmpi_tpu.models import MLP
+    from fluxmpi_tpu.utils import CheckpointManager
+
+    mesh = fm.init()
+    model = MLP(features=(8, 1))
+    opt = optax.adam(1e-2)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(16, 1)).astype(np.float32))
+    y = x**2
+
+    def loss_fn(p, mstate, b):
+        bx, by = b
+        return jnp.mean((model.apply(p, bx) - by) ** 2), mstate
+
+    step = make_train_step(loss_fn, opt, mesh=mesh, style="auto")
+    # Host copy: the compiled step donates its state, and a donated replica
+    # would tear the device buffers out from under later fresh() calls.
+    params = jax.device_get(model.init(jax.random.PRNGKey(0), x[:2]))
+    data = shard_batch((x, y), mesh)
+
+    def fresh():
+        return replicate(TrainState.create(params, opt), mesh)
+
+    # Uninterrupted run: 6 steps.
+    state = fresh()
+    for _ in range(6):
+        state, _ = step(state, data)
+    expected = jax.device_get(state.params)
+
+    # Interrupted run: 4 steps with checkpoints, then resume and finish.
+    mgr = CheckpointManager(str(tmp_path / "run"), max_to_keep=2,
+                            async_save=False)
+    state = fresh()
+    for i in range(4):
+        state, _ = step(state, data)
+        mgr.save(i + 1, state)
+    del state  # "crash"
+    last, state = mgr.restore(fresh())
+    assert last == 4
+    for _ in range(2):
+        state, _ = step(state, data)
+    resumed = jax.device_get(state.params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6),
+        resumed, expected,
+    )
+
+
+def test_checkpoint_manager_async_survives_donation(world, tmp_path):
+    # Code-review r3: async save must snapshot to host before returning —
+    # the caller's next (donating) train step invalidates the device
+    # buffers while the background thread is still writing.
+    import optax
+
+    import fluxmpi_tpu as fm
+    from fluxmpi_tpu.models import MLP
+    from fluxmpi_tpu.parallel import TrainState, make_train_step
+    from fluxmpi_tpu.parallel.train import replicate, shard_batch
+    from fluxmpi_tpu.utils import CheckpointManager
+
+    mesh = fm.init()
+    model = MLP(features=(8, 1))
+    opt = optax.adam(1e-2)
+    x = jnp.ones((16, 1), jnp.float32)
+    y = x**2
+
+    def loss_fn(p, mstate, b):
+        bx, by = b
+        return jnp.mean((model.apply(p, bx) - by) ** 2), mstate
+
+    # donate=True is the default; be explicit — it's the point of the test.
+    step = make_train_step(loss_fn, opt, mesh=mesh, style="auto", donate=True)
+    params = jax.device_get(model.init(jax.random.PRNGKey(0), x[:2]))
+    state = replicate(TrainState.create(params, opt), mesh)
+    data = shard_batch((x, y), mesh)
+
+    with CheckpointManager(str(tmp_path / "run"), async_save=True) as mgr:
+        for i in range(3):
+            state, _ = step(state, data)
+            saved = state
+            mgr.save(i + 1, saved)
+            # next loop iteration donates `state`'s buffers immediately
+        mgr.wait_until_finished()
+        assert mgr.all_steps() == [1, 2, 3]
+        last, restored = mgr.restore(
+            replicate(TrainState.create(params, opt), mesh)
+        )
+        assert last == 3
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-6
+            ),
+            jax.device_get(restored.params), jax.device_get(state.params),
+        )
