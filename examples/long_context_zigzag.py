@@ -1,0 +1,130 @@
+"""Long-context training with zigzag ring attention + flash kernels.
+
+Demonstrates the sequence-parallel stack end-to-end: a causal LM whose
+attention runs as balanced zigzag ring attention over an ``sp`` mesh axis,
+with the Pallas flash kernel as the local block attend, checkpointed via
+CheckpointManager. Runs on the simulated 8-device CPU mesh:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/long_context_zigzag.py
+
+The same script drives a real sp-sliced TPU pod unchanged.
+"""
+
+import os
+import tempfile
+
+if __name__ == "__main__" and "pytest" not in os.environ.get("_", ""):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+import fluxmpi_tpu as fm
+from fluxmpi_tpu.parallel import TrainState, make_train_step
+from fluxmpi_tpu.parallel.ring import zigzag_indices, zigzag_ring_attention
+from fluxmpi_tpu.parallel.train import replicate
+from fluxmpi_tpu.models import TransformerLM
+from fluxmpi_tpu.utils import CheckpointManager
+
+
+def main() -> None:
+    # dp for the batch, sp for the sequence — one mesh, two axes.
+    mesh = fm.init(mesh_shape={"dp": 2, "sp": 4}, verbose=True)
+    sp = mesh.shape["sp"]
+
+    vocab, seq, batch = 256, 128, 4
+    model = TransformerLM(
+        vocab_size=vocab, max_len=seq, num_layers=2, d_model=64,
+        num_heads=4, d_ff=128,
+        attention_fn=lambda q, k, v, bias=None, mask=None, **kw:
+            zigzag_ring_attention(q, k, v, axis_name="sp"),
+    )
+    # Zigzag layout: permute the token axis once on the way in; logits come
+    # back in the same permuted layout, so targets permute identically and
+    # the loss needs no inverse.
+    idxs = zigzag_indices(seq, sp)
+
+    dense_twin = TransformerLM(
+        vocab_size=vocab, max_len=seq, num_layers=2, d_model=64,
+        num_heads=4, d_ff=128,
+    )
+    rng = np.random.default_rng(0)
+    sample = jnp.asarray(rng.integers(0, vocab, size=(2, seq)), jnp.int32)
+    # Parameter trees are identical; init the dense twin (ring init needs a
+    # bound sp axis).
+    params = fm.synchronize(
+        dense_twin.init(jax.random.PRNGKey(fm.local_rank()), sample,
+                        train=False)
+    )
+
+    def loss_fn(p, mstate, batch_tokens):
+        # batch_tokens arrive zigzag-permuted along the sequence.
+        try:
+            shard_map = jax.shard_map
+        except AttributeError:  # pragma: no cover - older jax
+            from jax.experimental.shard_map import shard_map
+
+        def apply_local(p, toks):
+            return model.apply(p, toks, train=False)
+
+        logits = shard_map(
+            apply_local,
+            mesh=mesh,
+            in_specs=(P(), P("dp", "sp")),
+            out_specs=P("dp", "sp"),
+            check_vma=False,
+        )(p, batch_tokens)
+        # Next-token prediction in the ORIGINAL order: un-permute both
+        # logits and tokens, shift by one.
+        inv = jnp.argsort(jnp.asarray(idxs))
+        logits = logits[:, inv]
+        toks = batch_tokens[:, inv]
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], toks[:, 1:]
+        ).mean()
+        return loss, mstate
+
+    opt = optax.adam(1e-3)
+    step = make_train_step(
+        loss_fn, opt, mesh=mesh, style="auto", batch_spec=P("dp", "sp")
+    )
+    state = replicate(TrainState.create(params, opt), mesh)
+
+    tokens = jnp.asarray(
+        rng.integers(0, vocab, size=(batch, seq)), jnp.int32
+    )[:, idxs]  # zigzag once, train many
+
+    ckpt_dir = os.path.join(tempfile.mkdtemp(), "zigzag_run")
+    losses = []
+    with CheckpointManager(ckpt_dir, max_to_keep=2) as mgr:
+        for i in range(10):
+            state, loss = step(state, tokens)
+            losses.append(float(loss))
+            if (i + 1) % 5 == 0:
+                mgr.save(i + 1, state)
+        mgr.wait_until_finished()
+        assert mgr.latest_step() == 10
+
+    fm.fluxmpi_println(
+        f"zigzag LM: loss {losses[0]:.3f} -> {losses[-1]:.3f} over "
+        f"{len(losses)} steps (sp={sp}, seq={seq})"
+    )
+    assert losses[-1] < losses[0]
+    print("LONG_CONTEXT_ZIGZAG_OK")
+
+
+if __name__ == "__main__":
+    main()
