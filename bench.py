@@ -63,6 +63,7 @@ _PROBE_PLATFORMS = (None, "", "tpu")
 # host (1-core container, 8 virtual devices).
 _ANCHORS: dict[tuple[str, str, str], float] = {
     ("resnet50_images_per_sec_per_chip", "tpu", "TPU v5 lite"): 2509.5,
+    ("transformer_lm_tokens_per_sec_per_chip", "tpu", "TPU v5 lite"): 107622.4,
     ("mlp_quickstart_samples_per_sec_per_chip", "cpu", "cpu1"): 84080.6,
     ("cifar_cnn_images_per_sec_per_chip", "cpu", "cpu1"): 319.3,
 }
@@ -233,6 +234,7 @@ def _bench_workload(
     ndigits: int,
     analytic_flops_per_sample: float | None = None,
     loader_fed: bool = False,
+    value_scale: float = 1.0,
 ):
     """Shared harness: synthetic batch → compiled DP train step → per-chip
     throughput. ``make_model_batch(n_dev)`` returns
@@ -278,7 +280,7 @@ def _bench_workload(
     rate, state = _steps_per_sec(step, state, data, warmup=3, steps=steps)
     mfu = _mfu(flops_per_step, rate, n_dev, device_kind)
 
-    value = round(batch * rate / n_dev, ndigits)
+    value = round(batch * rate * value_scale / n_dev, ndigits)
     anchor = _anchor_for(metric_name)
     result = {
         "metric": metric_name,
@@ -377,7 +379,10 @@ def _bench_resnet50():  # pragma: no cover - requires accelerator time
         from fluxmpi_tpu.models import ResNet50
 
         model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
-        batch = 128 * n_dev
+        # Per-chip batch (v5e sweep: 64 → 2510, 128 → 2714 img/s; see
+        # FLUXMPI_TPU_RESNET_BATCH to re-sweep on other chips).
+        per_chip = int(os.environ.get("FLUXMPI_TPU_RESNET_BATCH", "128"))
+        batch = per_chip * n_dev
         x = jnp.ones((batch, 224, 224, 3), jnp.bfloat16)
         y = jnp.zeros((batch,), jnp.int32)
         return model, x, y, _bn_loss(model), optax.sgd(0.1, momentum=0.9)
@@ -452,6 +457,69 @@ def _bench_mlp():
         # 4-layer MLP 1→256→256→256→1: 2·Σ(in·out) MACs... FLOPs = 2×,
         # train step ≈ 3× fwd.
         analytic_flops_per_sample=3 * 2 * (256 + 256 * 256 * 2 + 256),
+    )
+
+
+def _bench_transformer():
+    """GPT-style LM train step with the Pallas flash attention: the
+    matmul-dense workload where MFU is meaningful (convnets at batch 128
+    plateau far lower). tokens/sec/chip + MFU."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    on_tpu = jax.default_backend() == "tpu"
+    vocab, seq = 32768, 1024
+    if on_tpu:
+        n_layers, d_model, n_heads, d_ff, per_chip = 8, 1024, 16, 4096, 8
+    else:  # CPU smoke configuration
+        n_layers, d_model, n_heads, d_ff, per_chip = 2, 128, 4, 256, 2
+
+    def make(n_dev):
+        from fluxmpi_tpu.models import TransformerLM
+        from fluxmpi_tpu.ops import flash_attention_fn
+
+        model = TransformerLM(
+            vocab_size=vocab, max_len=seq, num_layers=n_layers,
+            d_model=d_model, num_heads=n_heads, d_ff=d_ff,
+            dtype=jnp.bfloat16,
+            attention_fn=flash_attention_fn(causal=True),
+        )
+        batch = per_chip * n_dev
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(
+            rng.integers(0, vocab, size=(batch, seq)).astype(np.int32)
+        )
+        y = jnp.asarray(
+            rng.integers(0, vocab, size=(batch, seq)).astype(np.int32)
+        )
+
+        def loss_fn(p, mstate, b):
+            bx, by = b
+            logits = model.apply(p, bx, train=True)
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), by
+            ).mean()
+            return loss, mstate
+
+        return model, x, y, loss_fn, optax.adamw(1e-4)
+
+    # 6·N_params FLOPs per trained token (fwd 2N + bwd 4N), the standard
+    # decoder accounting. The embedding is weight-tied to the LM head
+    # (models/transformer.py: embed.attend), so vocab·d counts ONCE — the
+    # unembedding matmul; the input-side lookup is a gather, not FLOPs.
+    # The attention term ~12·L·d·s adds <10% at seq 1024 and is left out
+    # (slightly understating MFU rather than overstating it).
+    n_params = 12 * n_layers * d_model**2 + vocab * d_model
+    return _bench_workload(
+        make_model_batch=make,
+        stateful=False,
+        metric_name="transformer_lm_tokens_per_sec_per_chip",
+        unit="tokens/sec/chip",
+        steps=20,
+        ndigits=1,
+        analytic_flops_per_sample=6 * n_params * seq,
+        value_scale=seq,  # samples/sec → tokens/sec, inside the harness
     )
 
 
@@ -553,6 +621,7 @@ _CHILD_FNS = {
     "cnn": _bench_cnn,
     "mlp": _bench_mlp,
     "attention": _bench_attention,
+    "transformer": _bench_transformer,
 }
 
 
@@ -832,6 +901,15 @@ def main() -> None:
             result["attention"] = {
                 k: attn[k] for k in ("value", "unit", "per_seq")
                 if k in attn
+            }
+    if accel_ok and remaining() > 420 and result["metric"] != "bench_failed":
+        lm = _run_child(
+            "transformer", min(480.0, remaining() - 60), probe_platform
+        )
+        if lm is not None:
+            result["transformer_lm"] = {
+                k: lm[k] for k in ("value", "unit", "mfu", "vs_baseline")
+                if k in lm
             }
     if remaining() > 120 and result["metric"] != "bench_failed":
         scaling = _run_scaling(
