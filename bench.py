@@ -26,7 +26,8 @@ keyed by (metric, platform, device fingerprint) so a number from another
 machine is never presented as a regression ratio.
 
 Env knobs:
-  FLUXMPI_TPU_BENCH_CONFIG    force one config (resnet50|cnn|mlp|attention)
+  FLUXMPI_TPU_BENCH_CONFIG    force one config
+                              (resnet50|cnn|mlp|attention|transformer|deq)
   FLUXMPI_TPU_BENCH_TIMEOUT   override per-config child timeout in seconds
   FLUXMPI_TPU_BENCH_BUDGET    overall wall budget in seconds (default 1500)
   FLUXMPI_TPU_BENCH_PLATFORM  pin jax_platforms in children (e.g. "cpu")
@@ -460,6 +461,37 @@ def _bench_mlp():
     )
 
 
+def _bench_deq():
+    """Deep Equilibrium model (BASELINE config 4): implicit fixed-point
+    forward + custom-VJP implicit backward, per-chip samples/sec."""
+    import jax.numpy as jnp
+    import optax
+
+    def make(n_dev):
+        from fluxmpi_tpu.models import DEQ
+
+        model = DEQ(hidden=64, out=1)
+        batch = 2048 * n_dev
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.uniform(-2, 2, size=(batch, 1)).astype(np.float32))
+        y = x**2
+
+        def loss_fn(p, mstate, b):
+            bx, by = b
+            return jnp.mean((model.apply(p, bx) - by) ** 2), mstate
+
+        return model, x, y, loss_fn, optax.adam(1e-3)
+
+    return _bench_workload(
+        make_model_batch=make,
+        stateful=False,
+        metric_name="deq_samples_per_sec_per_chip",
+        unit="samples/sec/chip",
+        steps=30,
+        ndigits=1,
+    )
+
+
 def _bench_transformer():
     """GPT-style LM train step with the Pallas flash attention: the
     matmul-dense workload where MFU is meaningful (convnets at batch 128
@@ -622,6 +654,7 @@ _CHILD_FNS = {
     "mlp": _bench_mlp,
     "attention": _bench_attention,
     "transformer": _bench_transformer,
+    "deq": _bench_deq,
 }
 
 
@@ -910,6 +943,12 @@ def main() -> None:
             result["transformer_lm"] = {
                 k: lm[k] for k in ("value", "unit", "mfu", "vs_baseline")
                 if k in lm
+            }
+    if accel_ok and remaining() > 200 and result["metric"] != "bench_failed":
+        deq = _run_child("deq", min(240.0, remaining() - 60), probe_platform)
+        if deq is not None:
+            result["deq"] = {
+                k: deq[k] for k in ("value", "unit") if k in deq
             }
     if remaining() > 120 and result["metric"] != "bench_failed":
         scaling = _run_scaling(

@@ -28,6 +28,11 @@ from .sharding import (  # noqa: F401
     transformer_tp_rules,
     tree_partition_specs,
 )
+from .ulysses import (  # noqa: F401
+    make_ulysses_attention,
+    ulysses_attention,
+    ulysses_attention_fn,
+)
 from .train import (  # noqa: F401
     TrainState,
     make_eval_step,

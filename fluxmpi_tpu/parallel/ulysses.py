@@ -1,0 +1,195 @@
+"""Ulysses-style sequence parallelism — all-to-all head/sequence resharding.
+
+The second canonical long-context layout (DeepSpeed-Ulysses; the goal
+statement's "ring attention OR all-to-all sequence/context parallelism" —
+this module supplies the latter, :mod:`fluxmpi_tpu.parallel.ring` the
+former). The reference framework never touches the sequence dimension
+(SURVEY.md §5), so like the ring this is a capability extension.
+
+Mechanics, inside a ``shard_map`` whose in_specs shard the sequence over
+``axis_name`` (n devices):
+
+1. Q/K/V arrive ``[b, s/n, h, d]`` (sequence-sharded, all heads local).
+2. One ``lax.all_to_all`` per tensor reshards to ``[b, s, h/n, d]`` —
+   every device now holds the FULL sequence for ``h/n`` heads.
+3. Plain (or Pallas flash) attention runs locally — no communication in
+   the softmax, exact by construction (heads are independent).
+4. One ``all_to_all`` back returns ``[b, s/n, h, d]``.
+
+Trade-offs vs the ring: two all-to-alls of O(b·s·h·d/n) bytes per tensor
+replace n ppermute hops; peak activation memory is O(s) per device for the
+held heads (the ring keeps O(s/n)); the head count must be divisible by
+the axis size. On small meshes with ICI all-to-all (a torus native), this
+is usually faster than the ring for moderate sequences; the ring wins at
+extreme lengths where O(s) per device no longer fits. Both compose with
+``dp`` on the same mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import config
+from ._compat import shard_map_unchecked
+
+__all__ = ["ulysses_attention", "make_ulysses_attention", "ulysses_attention_fn"]
+
+
+def _local_full_attend(q, k, v, causal, segment_ids, use_flash, block_q, block_k):
+    from .ring import _local_attend
+
+    return _local_attend(
+        q, k, v, causal=causal, segment_ids=segment_ids,
+        use_flash=use_flash, block_q=block_q, block_k=block_k,
+    )
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str | None = None,
+    causal: bool = False,
+    segment_ids=None,
+    use_flash: bool = False,
+    block_q: int | None = None,
+    block_k: int | None = None,
+) -> jnp.ndarray:
+    """All-to-all sequence-parallel attention; call inside ``shard_map``
+    with the sequence dimension (axis 1) of q/k/v sharded over
+    ``axis_name`` and the head dimension (axis 2) divisible by that axis'
+    size.
+
+    ``segment_ids``: optional int32 **local shards** ``[batch, seq_local]``
+    (or a ``(q_seg, kv_seg)`` pair), flash-kernel convention (attend iff
+    ids equal and key id nonzero, 0 = padding); they are all-gathered to
+    the full sequence for the local attend (O(b·s) int32 — negligible).
+
+    Outside a bound axis (e.g. ``module.init``) this degrades to exact
+    single-device attention, like the ring.
+    """
+    name = axis_name or config.SP_AXIS_NAME
+    try:
+        n = jax.lax.axis_size(name)
+    except NameError:
+        return _local_full_attend(
+            q, k, v, causal, segment_ids, use_flash, block_q, block_k
+        )
+    b, s_local, h, d = q.shape
+    if h % n:
+        raise ValueError(
+            f"head count {h} must be divisible by the '{name}' axis size "
+            f"{n} (Ulysses shards heads; use ring_attention otherwise)"
+        )
+
+    def seq_to_heads(t):
+        # [b, s/n, h, d] → [b, s, h/n, d]: split heads across devices,
+        # concatenate the sequence. all_to_all splits axis 2 and
+        # concatenates along axis 1.
+        return jax.lax.all_to_all(
+            t, name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def heads_to_seq(t):
+        return jax.lax.all_to_all(
+            t, name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+
+    seg_full = None
+    if segment_ids is not None:
+        if isinstance(segment_ids, (tuple, list)):
+            qseg, kseg = segment_ids
+        else:
+            qseg = kseg = segment_ids
+        qseg_f = jax.lax.all_gather(
+            jnp.asarray(qseg, jnp.int32), name, axis=1, tiled=True
+        )
+        kseg_f = jax.lax.all_gather(
+            jnp.asarray(kseg, jnp.int32), name, axis=1, tiled=True
+        )
+        seg_full = (qseg_f, kseg_f)
+
+    out = _local_full_attend(
+        qg, kg, vg, causal, seg_full, use_flash, block_q, block_k
+    )
+    return heads_to_seq(out)
+
+
+def ulysses_attention_fn(
+    axis_name: str | None = None,
+    causal: bool = False,
+    use_flash: bool = False,
+    block_q: int | None = None,
+    block_k: int | None = None,
+):
+    """``attention_fn`` drop-in for ``nn.MultiHeadDotProductAttention``
+    modules applied inside a sequence-sharding ``shard_map`` (same usage
+    as :func:`fluxmpi_tpu.parallel.ring.ring_attention_fn`)."""
+
+    def fn(query, key, value, bias=None, mask=None, **kwargs):
+        if bias is not None or mask is not None:
+            raise ValueError(
+                "ulysses_attention_fn derives masking from causal/"
+                "segment_ids; pass causal=True instead of a mask/bias"
+            )
+        return ulysses_attention(
+            query, key, value, axis_name=axis_name, causal=causal,
+            use_flash=use_flash, block_q=block_q, block_k=block_k,
+        )
+
+    return fn
+
+
+def make_ulysses_attention(
+    mesh: Mesh | None = None,
+    *,
+    axis_name: str | None = None,
+    causal: bool = False,
+    batch_axis_name: str | None = None,
+    use_flash: bool = False,
+    block_q: int | None = None,
+    block_k: int | None = None,
+):
+    """Eager wrapper over mesh-sharded arrays (mirror of
+    :func:`fluxmpi_tpu.parallel.ring.make_ring_attention`)."""
+    from ..runtime import global_mesh
+
+    mesh = mesh or global_mesh()
+    sp = axis_name or config.SP_AXIS_NAME
+    dp = batch_axis_name
+    spec = P(dp, sp)
+
+    def body(q, k, v):
+        return ulysses_attention(
+            q, k, v, axis_name=sp, causal=causal, use_flash=use_flash,
+            block_q=block_q, block_k=block_k,
+        )
+
+    mapped = shard_map_unchecked(
+        body, mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )
+    jitted = jax.jit(mapped)
+
+    def fn(q, k, v):
+        size = mesh.shape[sp]
+        for name_, t in (("q", q), ("k", k), ("v", v)):
+            if t.shape[1] % size != 0:
+                raise ValueError(
+                    f"{name_} sequence length {t.shape[1]} must be divisible "
+                    f"by the '{sp}' mesh axis size {size} (pad the sequence)"
+                )
+            if t.shape[2] % size != 0:
+                raise ValueError(
+                    f"{name_} head count {t.shape[2]} must be divisible by "
+                    f"the '{sp}' axis size {size} (Ulysses shards heads)"
+                )
+        sharding = NamedSharding(mesh, spec)
+        q, k, v = (jax.device_put(t, sharding) for t in (q, k, v))
+        return jitted(q, k, v)
+
+    return fn
