@@ -426,27 +426,16 @@ def _bench_cnn():
 
 
 def _bench_mlp():
-    import jax.numpy as jnp
-    import optax
-
     def make(n_dev):
         from fluxmpi_tpu.models import MLP
 
-        model = MLP(features=(256, 256, 256, 1))
         # Per-chip batch; the scaling mode shrinks it (on a 1-core host, 8
         # virtual devices × 8192 samples serialize past XLA:CPU's 40 s
         # collective-rendezvous kill timer).
         per_chip = int(os.environ.get("FLUXMPI_TPU_BENCH_MLP_BATCH", "8192"))
-        batch = per_chip * n_dev
-        rng = np.random.default_rng(0)
-        x = jnp.asarray(rng.uniform(-2, 2, size=(batch, 1)).astype(np.float32))
-        y = x**2
-
-        def loss_fn(p, mstate, b):
-            bx, by = b
-            return jnp.mean((model.apply(p, bx) - by) ** 2), mstate
-
-        return model, x, y, loss_fn, optax.adam(1e-3)
+        return _regression_workload(
+            MLP(features=(256, 256, 256, 1)), per_chip, n_dev
+        )
 
     return _bench_workload(
         make_model_batch=make,
@@ -461,26 +450,32 @@ def _bench_mlp():
     )
 
 
+def _regression_workload(model, per_chip_batch: int, n_dev: int):
+    """Shared y=x² regression setup (quick-start parity task) used by the
+    mlp and deq configs — one place for data/loss/optimizer policy."""
+    import jax.numpy as jnp
+    import optax
+
+    batch = per_chip_batch * n_dev
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.uniform(-2, 2, size=(batch, 1)).astype(np.float32))
+    y = x**2
+
+    def loss_fn(p, mstate, b):
+        bx, by = b
+        return jnp.mean((model.apply(p, bx) - by) ** 2), mstate
+
+    return model, x, y, loss_fn, optax.adam(1e-3)
+
+
 def _bench_deq():
     """Deep Equilibrium model (BASELINE config 4): implicit fixed-point
     forward + custom-VJP implicit backward, per-chip samples/sec."""
-    import jax.numpy as jnp
-    import optax
 
     def make(n_dev):
         from fluxmpi_tpu.models import DEQ
 
-        model = DEQ(hidden=64, out=1)
-        batch = 2048 * n_dev
-        rng = np.random.default_rng(0)
-        x = jnp.asarray(rng.uniform(-2, 2, size=(batch, 1)).astype(np.float32))
-        y = x**2
-
-        def loss_fn(p, mstate, b):
-            bx, by = b
-            return jnp.mean((model.apply(p, bx) - by) ** 2), mstate
-
-        return model, x, y, loss_fn, optax.adam(1e-3)
+        return _regression_workload(DEQ(hidden=64, out=1), 2048, n_dev)
 
     return _bench_workload(
         make_model_batch=make,

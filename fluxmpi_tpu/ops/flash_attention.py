@@ -78,15 +78,28 @@ def _row_spec(block: int, order):
     return pl.BlockSpec((1, _SUBLANES, block), lambda g0, g1, g2: (g0, 0, order(g1, g2)))
 
 
-def _pos_mask(qi, kj, block_q: int, block_k: int):
-    """Causal positional mask for the (qi, kj) tile: True = attend."""
+def _pos_mask(qi, kj, block_q: int, block_k: int, window: int | None = None):
+    """Causal positional mask for the (qi, kj) tile: True = attend. With
+    ``window``, additionally requires ``q_pos - k_pos < window`` (sliding-
+    window / local attention, Mistral-style)."""
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0
     )
     k_pos = kj * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1
     )
-    return q_pos >= k_pos
+    mask = q_pos >= k_pos
+    if window is not None:
+        mask = mask & (q_pos - k_pos < window)
+    return mask
+
+
+def _window_tile_live(qi, kj, block_q: int, block_k: int, window: int):
+    """Static tile-skip predicate for the sliding-window band: the tile has
+    an in-window pair iff its closest (first q row, last k col) pair is
+    within the window. Shared by all three kernels so forward and backward
+    masking cannot desynchronize."""
+    return qi * block_q - ((kj + 1) * block_k - 1) < window
 
 
 def _seg_mask(qseg_col, kseg_row):
@@ -106,6 +119,7 @@ def _flash_kernel(
     *refs,
     sm_scale: float,
     causal: bool,
+    window: int | None,
     has_segments: bool,
     block_q: int,
     block_k: int,
@@ -131,7 +145,7 @@ def _flash_kernel(
     def _tile_mask():
         mask = None
         if causal:
-            mask = _pos_mask(qi, kj, block_q, block_k)
+            mask = _pos_mask(qi, kj, block_q, block_k, window)
         if has_segments:
             # qseg lane-replicated → [block_q, 1] column; kseg
             # sublane-replicated → [1, block_k] row.
@@ -179,6 +193,8 @@ def _flash_kernel(
     preds = []
     if causal:
         preds.append(kj * block_k < (qi + 1) * block_q)
+        if window is not None:
+            preds.append(_window_tile_live(qi, kj, block_q, block_k, window))
     if has_segments:
         preds.append(
             jnp.any(_seg_mask(qseg_ref[0][:, :1], kseg_ref[0][:1, :]))
@@ -205,6 +221,7 @@ def _flash_bwd_dq_kernel(
     *refs,
     sm_scale: float,
     causal: bool,
+    window: int | None,
     has_segments: bool,
     block_q: int,
     block_k: int,
@@ -242,7 +259,7 @@ def _flash_bwd_dq_kernel(
         p = jnp.exp(s - lse)  # normalized probabilities
         mask = None
         if causal:
-            mask = _pos_mask(qi, kj, block_q, block_k)
+            mask = _pos_mask(qi, kj, block_q, block_k, window)
         if has_segments:
             sm = _seg_mask(qseg_ref[0][:, :1], kseg_ref[0][:1, :])
             mask = sm if mask is None else jnp.logical_and(mask, sm)
@@ -259,6 +276,8 @@ def _flash_bwd_dq_kernel(
     preds = []
     if causal:
         preds.append(kj * block_k < (qi + 1) * block_q)
+        if window is not None:
+            preds.append(_window_tile_live(qi, kj, block_q, block_k, window))
     if has_segments:
         preds.append(
             jnp.any(_seg_mask(qseg_ref[0][:, :1], kseg_ref[0][:1, :]))
@@ -279,6 +298,7 @@ def _flash_bwd_dkv_kernel(
     *refs,
     sm_scale: float,
     causal: bool,
+    window: int | None,
     has_segments: bool,
     block_q: int,
     block_k: int,
@@ -318,6 +338,8 @@ def _flash_bwd_dkv_kernel(
                 jnp.int32, (block_k, block_q), 1
             )
             mask = q_pos >= k_pos
+            if window is not None:
+                mask = mask & (q_pos - k_pos < window)
         if has_segments:
             kseg = kseg_ref[0][:, :1]
             qseg = qseg_ref[0][:1, :]
@@ -356,6 +378,9 @@ def _flash_bwd_dkv_kernel(
         # Skip q-blocks entirely in the past of this k-block (every score
         # masked).
         preds.append((qi + 1) * block_q > kj * block_k)
+        if window is not None:
+            # ...and q-blocks entirely beyond the window's future edge.
+            preds.append(_window_tile_live(qi, kj, block_q, block_k, window))
     if has_segments:
         preds.append(
             jnp.any(
@@ -405,7 +430,8 @@ def _seg_specs(h: int, qblock: int, kblock: int, q_order, k_order):
     )
 
 
-def _fwd_pallas(q, k, v, qseg, kseg, causal, block_q, block_k, interpret):
+def _fwd_pallas(q, k, v, qseg, kseg, causal, window, block_q, block_k,
+                interpret):
     from jax.experimental.pallas import tpu as pltpu
 
     b, sq, h, d = q.shape
@@ -420,6 +446,7 @@ def _fwd_pallas(q, k, v, qseg, kseg, causal, block_q, block_k, interpret):
         _flash_kernel,
         sm_scale=sm_scale,
         causal=causal,
+        window=window,
         has_segments=has_segments,
         block_q=block_q,
         block_k=block_k,
@@ -466,7 +493,8 @@ def _fwd_pallas(q, k, v, qseg, kseg, causal, block_q, block_k, interpret):
 
 
 def _bwd_pallas(
-    q, k, v, qseg, kseg, out, lse, do, dlse, causal, block_q, block_k, interpret
+    q, k, v, qseg, kseg, out, lse, do, dlse, causal, window, block_q,
+    block_k, interpret
 ):
     from jax.experimental.pallas import tpu as pltpu
 
@@ -514,6 +542,7 @@ def _bwd_pallas(
             _flash_bwd_dq_kernel,
             sm_scale=sm_scale,
             causal=causal,
+            window=window,
             has_segments=has_segments,
             block_q=block_q,
             block_k=block_k,
@@ -562,6 +591,7 @@ def _bwd_pallas(
             _flash_bwd_dkv_kernel,
             sm_scale=sm_scale,
             causal=causal,
+            window=window,
             has_segments=has_segments,
             block_q=block_q,
             block_k=block_k,
@@ -594,16 +624,17 @@ def _bwd_pallas(
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def _flash(q, k, v, qseg, kseg, causal, block_q, block_k, interpret):
-    out, lse = _fwd_pallas(q, k, v, qseg, kseg, causal, block_q, block_k,
-                           interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash(q, k, v, qseg, kseg, causal, window, block_q, block_k, interpret):
+    out, lse = _fwd_pallas(q, k, v, qseg, kseg, causal, window, block_q,
+                           block_k, interpret)
     return out, lse
 
 
-def _flash_fwd(q, k, v, qseg, kseg, causal, block_q, block_k, interpret):
-    out, lse = _fwd_pallas(q, k, v, qseg, kseg, causal, block_q, block_k,
-                           interpret)
+def _flash_fwd(q, k, v, qseg, kseg, causal, window, block_q, block_k,
+               interpret):
+    out, lse = _fwd_pallas(q, k, v, qseg, kseg, causal, window, block_q,
+                           block_k, interpret)
     return (out, lse), (q, k, v, qseg, kseg, out, lse)
 
 
@@ -615,12 +646,12 @@ def _seg_ct(seg):
     return np.zeros(seg.shape, jax.dtypes.float0)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, res, cotangents):
+def _flash_bwd(causal, window, block_q, block_k, interpret, res, cotangents):
     q, k, v, qseg, kseg, out, lse = res
     do, dlse = cotangents
     dq, dk, dv = _bwd_pallas(
-        q, k, v, qseg, kseg, out, lse, do, dlse, causal, block_q, block_k,
-        interpret
+        q, k, v, qseg, kseg, out, lse, do, dlse, causal, window, block_q,
+        block_k, interpret
     )
     return dq, dk, dv, _seg_ct(qseg), _seg_ct(kseg)
 
@@ -685,6 +716,19 @@ def _auto_block(s: int, cap: int) -> int:
     return b if b >= 8 and s % b == 0 else s
 
 
+def _check_window(window, causal):
+    if window is None:
+        return None
+    if not causal:
+        raise ValueError(
+            "window (sliding-window attention) requires causal=True"
+        )
+    window = int(window)
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    return window
+
+
 def _prepare(q, k, v, block_q, block_k, interpret):
     b, sq, h, d = q.shape
     sk = k.shape[1]
@@ -705,7 +749,8 @@ def _prepare(q, k, v, block_q, block_k, interpret):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+    jax.jit,
+    static_argnames=("causal", "window", "block_q", "block_k", "interpret"),
 )
 def flash_attention(
     q: jnp.ndarray,
@@ -713,6 +758,7 @@ def flash_attention(
     v: jnp.ndarray,
     *,
     causal: bool = False,
+    window: int | None = None,
     segment_ids=None,
     block_q: int | None = None,
     block_k: int | None = None,
@@ -730,17 +776,25 @@ def flash_attention(
     iff their ids match and the key id is nonzero; id 0 marks padding
     (:func:`padding_to_segment_ids`). Fully-masked tiles skip compute.
     Rows with no attendable keys output zeros.
+
+    ``window``: sliding-window (local) attention — with ``causal=True``,
+    position i attends keys in ``(i-window, i]`` only; tiles entirely
+    outside the band are skipped, so compute is O(seq·window) not
+    O(seq²). Requires ``causal=True``.
     """
+    window = _check_window(window, causal)
     block_q, block_k, interpret = _prepare(q, k, v, block_q, block_k, interpret)
     qseg, kseg = _normalize_segments(
         segment_ids, q.shape[0], q.shape[1], k.shape[1]
     )
-    out, _ = _flash(q, k, v, qseg, kseg, causal, block_q, block_k, interpret)
+    out, _ = _flash(q, k, v, qseg, kseg, causal, window, block_q, block_k,
+                    interpret)
     return out
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+    jax.jit,
+    static_argnames=("causal", "window", "block_q", "block_k", "interpret"),
 )
 def flash_attention_with_lse(
     q: jnp.ndarray,
@@ -748,6 +802,7 @@ def flash_attention_with_lse(
     v: jnp.ndarray,
     *,
     causal: bool = False,
+    window: int | None = None,
     segment_ids=None,
     block_q: int | None = None,
     block_k: int | None = None,
@@ -759,11 +814,13 @@ def flash_attention_with_lse(
     in both outputs (the lse cotangent folds into the backward's dS term).
     Rows with no attendable keys report ``lse ≈ -1e30`` (zero merge weight).
     """
+    window = _check_window(window, causal)
     block_q, block_k, interpret = _prepare(q, k, v, block_q, block_k, interpret)
     qseg, kseg = _normalize_segments(
         segment_ids, q.shape[0], q.shape[1], k.shape[1]
     )
-    return _flash(q, k, v, qseg, kseg, causal, block_q, block_k, interpret)
+    return _flash(q, k, v, qseg, kseg, causal, window, block_q, block_k,
+                  interpret)
 
 
 def _segments_from_attention_mask(mask, b, sq, sk, causal):
@@ -859,6 +916,7 @@ def _mask_fidelity(mask, q_seg, kv_seg, causal):
 def flash_attention_fn(
     causal: bool = False,
     *,
+    window: int | None = None,
     block_q: int | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
@@ -901,6 +959,7 @@ def flash_attention_fn(
             key,
             value,
             causal=causal,
+            window=window,
             segment_ids=segment_ids,
             block_q=block_q,
             block_k=block_k,

@@ -33,17 +33,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import config
 from ._compat import shard_map_unchecked
+from .ring import _local_attend
 
 __all__ = ["ulysses_attention", "make_ulysses_attention", "ulysses_attention_fn"]
-
-
-def _local_full_attend(q, k, v, causal, segment_ids, use_flash, block_q, block_k):
-    from .ring import _local_attend
-
-    return _local_attend(
-        q, k, v, causal=causal, segment_ids=segment_ids,
-        use_flash=use_flash, block_q=block_q, block_k=block_k,
-    )
 
 
 def ulysses_attention(
@@ -75,8 +67,9 @@ def ulysses_attention(
     try:
         n = jax.lax.axis_size(name)
     except NameError:
-        return _local_full_attend(
-            q, k, v, causal, segment_ids, use_flash, block_q, block_k
+        return _local_attend(
+            q, k, v, causal=causal, segment_ids=segment_ids,
+            use_flash=use_flash, block_q=block_q, block_k=block_k,
         )
     b, s_local, h, d = q.shape
     if h % n:
@@ -114,8 +107,9 @@ def ulysses_attention(
         )
         seg_full = (qseg_f, kseg_f)
 
-    out = _local_full_attend(
-        qg, kg, vg, causal, seg_full, use_flash, block_q, block_k
+    out = _local_attend(
+        qg, kg, vg, causal=causal, segment_ids=seg_full,
+        use_flash=use_flash, block_q=block_q, block_k=block_k,
     )
     return heads_to_seq(out)
 

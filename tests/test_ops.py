@@ -388,3 +388,82 @@ def test_flash_fn_poisons_unrepresentable_mask(world):
     pad_mask = nn.make_attention_mask(valid, valid)
     out = flash_attention_fn(block_q=16, block_k=16)(q, k, v, mask=pad_mask)
     assert not np.any(np.isnan(np.asarray(out, dtype=np.float32)))
+
+
+def _dense_window(q, k, v, window):
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    sq, sk = q.shape[1], k.shape[1]
+    qpos = jnp.arange(sq)[:, None]
+    kpos = jnp.arange(sk)[None, :]
+    mask = (qpos >= kpos) & (qpos - kpos < window)
+    s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.mark.parametrize("window", [16, 40])
+def test_flash_sliding_window(world, window):
+    # Mistral-style local attention: position i attends (i-window, i].
+    from fluxmpi_tpu.ops import flash_attention
+
+    q, k, v = _qkv(s=128, seed=30)
+    out = flash_attention(q, k, v, causal=True, window=window,
+                          block_q=16, block_k=16)
+    expected = _dense_window(q, k, v, window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               atol=2e-5)
+
+
+def test_flash_sliding_window_grads(world):
+    from fluxmpi_tpu.ops import flash_attention
+
+    q, k, v = _qkv(s=64, seed=31)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(jnp.sin(flash_attention(
+            q, k, v, causal=True, window=24, block_q=16, block_k=16)))
+
+    def loss_dense(q, k, v):
+        return jnp.sum(jnp.sin(_dense_window(q, k, v, 24)))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_flash_window_requires_causal(world):
+    from fluxmpi_tpu.ops import flash_attention
+
+    q, k, v = _qkv(seed=32)
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, k, v, window=16)
+
+
+def test_flash_window_composes_with_segments(world):
+    from fluxmpi_tpu.ops import flash_attention
+
+    q, k, v = _qkv(s=64, seed=33)
+    seg = np.ones((2, 64), np.int32)
+    seg[0, 48:] = 0  # pad tail
+    seg = jnp.asarray(seg)
+    out = flash_attention(q, k, v, causal=True, window=24, segment_ids=seg,
+                          block_q=16, block_k=16)
+    # dense oracle: window ∧ causal ∧ segments
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    qpos = jnp.arange(64)[:, None]
+    kpos = jnp.arange(64)[None, :]
+    mask = (qpos >= kpos) & (qpos - kpos < 24)
+    mask = mask[None] & (seg[:, :, None] == seg[:, None, :]) & (
+        seg[:, None, :] != 0
+    )
+    s = jnp.where(mask[:, None], s, -1e30)
+    expected = jnp.einsum(
+        "bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v
+    )
+    ok = np.asarray(seg) != 0
+    np.testing.assert_allclose(
+        np.asarray(out)[ok], np.asarray(expected)[ok], atol=2e-5
+    )
