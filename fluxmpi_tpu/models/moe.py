@@ -48,15 +48,15 @@ __all__ = [
 
 
 class MoEMLP(nn.Module):
-    """Top-1 (Switch) mixture-of-experts feed-forward layer with grouped
-    routing.
+    """Mixture-of-experts feed-forward layer with grouped routing — Switch
+    top-1 by default, GShard top-2 via ``top_k=2``.
 
     Input/output ``(..., d_model)``. Tokens are routed per *group*:
     ``n_groups`` explicit groups, or by default one group per leading
     (batch) row for inputs of rank ≥ 3 — the dimension dp shards, so
     routing stays shard-local. Per-expert capacity is per group:
-    ``ceil(group_size * capacity_factor / num_experts)`` (NOT over the
-    global token count); overflow drops are likewise group-local.
+    ``ceil(group_size * capacity_factor * top_k / num_experts)`` (NOT over
+    the global token count); overflow drops are likewise group-local.
     """
 
     num_experts: int = 8
@@ -65,6 +65,10 @@ class MoEMLP(nn.Module):
     dtype: jnp.dtype = jnp.float32
     router_noise: float = 0.0
     n_groups: int | None = None
+    # Routing fan-out: 1 = Switch top-1, 2 = GShard top-2 (renormalized
+    # gates, first choices claim capacity first). Capacity scales with
+    # top_k: ceil(group_size · capacity_factor · top_k / E).
+    top_k: int = 1
     # Expert-parallel lowering pin: with a mesh, the expert-major
     # activations are sharding-constrained to (group→dp, expert→ep), which
     # forces XLA's partitioner to MOVE THE TOKENS (all-to-all over the ep
@@ -149,28 +153,58 @@ class MoEMLP(nn.Module):
                 rng, logits.shape
             )
         probs = jax.nn.softmax(logits, axis=-1)  # [G, S, E]
-        expert_idx = jnp.argmax(probs, axis=-1)  # [G, S]
-        expert_gate = jnp.take_along_axis(
-            probs, expert_idx[..., None], axis=-1
-        )[..., 0]  # [G, S]
 
+        if not 1 <= self.top_k <= self.num_experts:
+            raise ValueError(
+                f"top_k={self.top_k} must be in [1, num_experts="
+                f"{self.num_experts}]"
+            )
         capacity = max(
-            1, int(-(-gs * self.capacity_factor // self.num_experts))
+            1, int(-(-gs * self.capacity_factor * self.top_k
+                     // self.num_experts))
         )
-        onehot = jax.nn.one_hot(expert_idx, self.num_experts, dtype=jnp.float32)
-        # Position of each token within its expert's per-group buffer
-        # (0-based); the cumsum runs over the group-local token axis only.
-        pos_in_expert = (jnp.cumsum(onehot, axis=1) - 1.0) * onehot  # [G, S, E]
-        kept = (pos_in_expert < capacity) & (onehot > 0)  # [G, S, E] bool
-        pos_oh = jax.nn.one_hot(
-            pos_in_expert.astype(jnp.int32), capacity, dtype=jnp.float32
-        )  # [G, S, E, C]
-        dispatch = pos_oh * kept[..., None].astype(jnp.float32)  # [G, S, E, C]
-        combine = dispatch * expert_gate[..., None, None]  # [G, S, E, C]
 
-        # Load-balancing aux loss (Switch eq. 4), computed per group and
-        # averaged: E * mean_g sum_e f_ge * P_ge.
-        frac_tokens = jnp.mean(onehot, axis=1)  # [G, E]
+        # Top-k routing (GShard-style for k=2; Switch for k=1): choices are
+        # prioritized — every first choice claims expert capacity before
+        # any second choice (computed as a cumulative per-expert count
+        # offset), so a congested expert drops k=2 traffic first.
+        _, topk_idx = jax.lax.top_k(probs, self.top_k)  # [G, S, K]
+        gates = jnp.take_along_axis(probs, topk_idx, axis=-1)  # [G, S, K]
+        if self.top_k > 1:
+            # Renormalize the kept gates (GShard): combine weights sum to 1
+            # over the token's chosen experts.
+            gates = gates / jnp.maximum(
+                jnp.sum(gates, axis=-1, keepdims=True), 1e-9
+            )
+
+        dispatch = jnp.zeros(
+            (groups, gs, self.num_experts, capacity), jnp.float32
+        )
+        combine = jnp.zeros_like(dispatch)
+        counts = jnp.zeros((groups, 1, self.num_experts), jnp.float32)
+        onehot1 = None
+        for choice in range(self.top_k):
+            onehot = jax.nn.one_hot(
+                topk_idx[..., choice], self.num_experts, dtype=jnp.float32
+            )  # [G, S, E]
+            if onehot1 is None:
+                onehot1 = onehot
+            # Position within the expert buffer: earlier choices' totals
+            # offset this choice's group-local cumsum.
+            pos = (jnp.cumsum(onehot, axis=1) - 1.0 + counts) * onehot
+            kept = (pos < capacity) & (onehot > 0)
+            pos_oh = jax.nn.one_hot(
+                pos.astype(jnp.int32), capacity, dtype=jnp.float32
+            )  # [G, S, E, C]
+            d = pos_oh * kept[..., None].astype(jnp.float32)
+            dispatch = dispatch + d
+            combine = combine + d * gates[..., choice, None, None]
+            counts = counts + jnp.sum(onehot, axis=1, keepdims=True)
+
+        # Load-balancing aux loss (Switch eq. 4 / GShard: first-choice
+        # fractions), computed per group and averaged:
+        # E * mean_g sum_e f_ge * P_ge.
+        frac_tokens = jnp.mean(onehot1, axis=1)  # [G, E]
         frac_probs = jnp.mean(probs, axis=1)  # [G, E]
         aux_loss = self.num_experts * jnp.mean(
             jnp.sum(frac_tokens * frac_probs, axis=-1)
@@ -224,6 +258,7 @@ class MoEEncoderBlock(EncoderBlock):
     mesh: Any = None
     ep_axis: str | None = None
     dp_axis: str | None = None
+    top_k: int = 1
 
     def make_ff(self) -> nn.Module:
         return MoEMLP(
@@ -232,6 +267,7 @@ class MoEEncoderBlock(EncoderBlock):
             capacity_factor=self.capacity_factor,
             dtype=self.dtype,
             n_groups=self.n_groups,
+            top_k=self.top_k,
             mesh=self.mesh,
             ep_axis=self.ep_axis,
             dp_axis=self.dp_axis,
@@ -248,6 +284,7 @@ class MoEEncoder(TransformerEncoder):
     mesh: Any = None
     ep_axis: str | None = None
     dp_axis: str | None = None
+    top_k: int = 1
 
     def make_block(self, i: int) -> nn.Module:
         return MoEEncoderBlock(
@@ -260,6 +297,7 @@ class MoEEncoder(TransformerEncoder):
             num_experts=self.num_experts,
             capacity_factor=self.capacity_factor,
             n_groups=self.n_groups,
+            top_k=self.top_k,
             mesh=self.mesh,
             ep_axis=self.ep_axis,
             dp_axis=self.dp_axis,
@@ -279,6 +317,7 @@ class MoETransformerLM(TransformerLM):
     mesh: Any = None
     ep_axis: str | None = None
     dp_axis: str | None = None
+    top_k: int = 1
 
     def make_encoder(self) -> nn.Module:
         return MoEEncoder(
@@ -292,6 +331,7 @@ class MoETransformerLM(TransformerLM):
             num_experts=self.num_experts,
             capacity_factor=self.capacity_factor,
             n_groups=self.n_groups,
+            top_k=self.top_k,
             mesh=self.mesh,
             ep_axis=self.ep_axis,
             dp_axis=self.dp_axis,

@@ -245,3 +245,112 @@ def test_ep_moe_lowers_to_all_to_all(world):
         state, loss = step(state, batch)
         losses.append(float(loss))
     assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+
+def test_top2_routing_matches_oracle(world):
+    # GShard top-2: with ample capacity every token's output is the
+    # renormalized-gate-weighted sum of its two best experts' FFN outputs.
+    from fluxmpi_tpu.models import MoEMLP
+
+    d_model, d_ff, E = 8, 16, 4
+    layer = MoEMLP(num_experts=E, d_ff=d_ff, capacity_factor=8.0, top_k=2)
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(2, 6, d_model)).astype(np.float32))
+    params = layer.init(jax.random.PRNGKey(0), x, train=False)
+    out, _ = layer.apply(params, x, train=False, mutable=["losses"])
+
+    p = params["params"]
+    logits = np.asarray(x) @ np.asarray(p["router"])
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    w1, b1 = np.asarray(p["w1"]), np.asarray(p["b1"])
+    w2, b2 = np.asarray(p["w2"]), np.asarray(p["b2"])
+
+    def expert_ffn(e, t):
+        import jax.nn as jnn
+
+        h = np.asarray(jnn.gelu(jnp.asarray(t @ w1[e] + b1[e])))
+        return h @ w2[e] + b2[e]
+
+    expected = np.zeros_like(np.asarray(out))
+    for b in range(x.shape[0]):
+        for s in range(x.shape[1]):
+            pr = probs[b, s]
+            top2 = np.argsort(-pr)[:2]
+            g = pr[top2] / pr[top2].sum()
+            for gi, e in zip(g, top2):
+                expected[b, s] += gi * expert_ffn(e, np.asarray(x[b, s]))
+    np.testing.assert_allclose(np.asarray(out), expected, atol=2e-5)
+
+
+def test_top2_first_choice_has_capacity_priority(world):
+    # Capacity 1 per expert; t0 first-chooses e0 (second e1), t1 the
+    # mirror. Correct priority: both first choices keep their slots, both
+    # second choices find the OTHER expert already full (the prior-choice
+    # count offset) and drop — so each token's output carries ONLY its
+    # first expert's signature. Dropping the offset (or inverting the
+    # choice order) would keep a second choice and mix both signatures.
+    from fluxmpi_tpu.models import MoEMLP
+
+    d_model, E = 2, 2
+    layer = MoEMLP(num_experts=E, d_ff=4, capacity_factor=0.5, top_k=2)
+    # logits: t0=[1,0] → [2,1] (e0 first); t1=[0,1] → [1,2] (e1 first)
+    x = jnp.asarray([[[1.0, 0.0], [0.0, 1.0]]])
+    params = layer.init(jax.random.PRNGKey(0), x, train=False)
+    p = jax.tree_util.tree_map(lambda a: a, params)  # shallow copy ok
+    pp = dict(p["params"])
+    pp["router"] = jnp.asarray([[2.0, 1.0], [1.0, 2.0]])
+    # Experts output exactly b2[e] (w2 = 0): a per-expert signature.
+    pp["w2"] = jnp.zeros_like(pp["w2"])
+    pp["b2"] = jnp.asarray([[10.0, 0.0], [0.0, 10.0]])
+    params = {"params": pp}
+
+    out, _ = layer.apply(params, x, train=False, mutable=["losses"])
+    out = np.asarray(out)
+
+    probs = np.exp([2.0, 1.0])
+    g0 = probs[0] / probs.sum()  # renormalized top-2 first gate ≈ 0.731
+    np.testing.assert_allclose(out[0, 0], [10.0 * g0, 0.0], atol=1e-5)
+    np.testing.assert_allclose(out[0, 1], [0.0, 10.0 * g0], atol=1e-5)
+
+
+def test_topk_out_of_range_raises(world):
+    from fluxmpi_tpu.models import MoEMLP
+
+    layer = MoEMLP(num_experts=4, d_ff=8, top_k=8)
+    x = jnp.ones((1, 4, 8))
+    with pytest.raises(ValueError, match="top_k"):
+        layer.init(jax.random.PRNGKey(0), x, train=False)
+
+
+def test_top1_unchanged_by_topk_code(world):
+    # The Switch path (top_k=1, the default) must be bit-identical to the
+    # pre-top-k formulation: single choice, unnormalized gate.
+    from fluxmpi_tpu.models import MoEMLP
+
+    d_model = 8
+    # Ample capacity: the oracle below has no drop modeling.
+    layer = MoEMLP(num_experts=4, d_ff=16, capacity_factor=8.0)
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(size=(2, 6, d_model)).astype(np.float32))
+    params = layer.init(jax.random.PRNGKey(0), x, train=False)
+    out, state = layer.apply(params, x, train=False, mutable=["losses"])
+
+    p = params["params"]
+    logits = np.asarray(x) @ np.asarray(p["router"])
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    top1 = probs.argmax(-1)
+    gate = np.take_along_axis(probs, top1[..., None], -1)[..., 0]
+    w1, b1 = np.asarray(p["w1"]), np.asarray(p["b1"])
+    w2, b2 = np.asarray(p["w2"]), np.asarray(p["b2"])
+
+    import jax.nn as jnn
+
+    expected = np.zeros_like(np.asarray(out))
+    for b in range(2):
+        for s in range(6):
+            e = top1[b, s]
+            h = np.asarray(jnn.gelu(jnp.asarray(np.asarray(x[b, s]) @ w1[e] + b1[e])))
+            expected[b, s] = gate[b, s] * (h @ w2[e] + b2[e])
+    np.testing.assert_allclose(np.asarray(out), expected, atol=2e-5)
