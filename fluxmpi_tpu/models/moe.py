@@ -3,9 +3,10 @@
 The reference framework has no MoE (SURVEY.md §2 parallelism inventory:
 "Expert parallelism (EP/MoE): No"); this is a capability extension in the
 same spirit as ring attention — the mesh design makes a new axis one
-declaration away. The layer is Switch-Transformer-style top-1 routing with
-static capacity, built entirely from dense einsums over static shapes so XLA
-can tile everything onto the MXU:
+declaration away. The layer routes Switch-Transformer-style top-1 by
+default (GShard top-2 via ``top_k=2``) with static capacity, built
+entirely from dense einsums over static shapes so XLA can tile everything
+onto the MXU:
 
 - routing is grouped (mesh-TF/Switch style): tokens reshape to
   ``[groups, group_size]`` (groups default to the batch dimension, which is
