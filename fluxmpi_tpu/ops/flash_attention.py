@@ -303,11 +303,15 @@ def _flash_bwd_dkv_kernel(
     block_q: int,
     block_k: int,
     num_q_blocks: int,
+    total_q_iters: int,
 ):
-    """dK/dV pass: for each K/V block, sweep Q blocks (innermost grid dim),
-    accumulating ``dv += pᵀ @ dO`` and ``dk += (p ∘ (dp - dterm))ᵀ @ Q ·
-    scale`` in VMEM scratch (transposed forms computed directly to keep the
-    contraction on the MXU)."""
+    """dK/dV pass: for each K/V block, sweep Q blocks — and, under GQA, the
+    whole query-head group — in the innermost grid dim, accumulating
+    ``dv += pᵀ @ dO`` and ``dk += (p ∘ (dp - dterm))ᵀ @ Q · scale`` in f32
+    VMEM scratch (transposed forms computed directly to keep the
+    contraction on the MXU). One grid row per KV head: the group-summed
+    gradient is written once, full f32 accumulation, no q-head-granularity
+    HBM temporaries."""
     if has_segments:
         (q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref, lse_ref,
          dterm_ref, dk_ref, dv_ref, dk_scratch, dv_scratch) = refs
@@ -317,9 +321,10 @@ def _flash_bwd_dkv_kernel(
         qseg_ref = kseg_ref = None
 
     kj = pl.program_id(1)
-    qi = pl.program_id(2)
+    it = pl.program_id(2)  # group-major: it = group_idx·num_q_blocks + qi
+    qi = it % num_q_blocks
 
-    @pl.when(qi == 0)
+    @pl.when(it == 0)
     def _init():
         dk_scratch[...] = jnp.zeros_like(dk_scratch)
         dv_scratch[...] = jnp.zeros_like(dv_scratch)
@@ -395,7 +400,7 @@ def _flash_bwd_dkv_kernel(
     else:
         _compute()
 
-    @pl.when(qi == num_q_blocks - 1)
+    @pl.when(it == total_q_iters - 1)
     def _finish():
         dk_ref[0] = dk_scratch[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_scratch[...].astype(dv_ref.dtype)
@@ -430,12 +435,26 @@ def _seg_specs(h: int, qblock: int, kblock: int, q_order, k_order):
     )
 
 
+def _kv_row(h: int, h_kv: int):
+    """Folded-row index map for grouped-query attention: q row
+    ``b_idx·h + h_idx`` reads kv row ``b_idx·h_kv + h_idx // group``
+    (plain multi-head when h == h_kv)."""
+    group = h // h_kv
+
+    def row(bh):
+        return (bh // h) * h_kv + (bh % h) // group
+
+    return row
+
+
 def _fwd_pallas(q, k, v, qseg, kseg, causal, window, block_q, block_k,
                 interpret):
     from jax.experimental.pallas import tpu as pltpu
 
     b, sq, h, d = q.shape
     sk = k.shape[1]
+    h_kv = k.shape[2]
+    kv_row = _kv_row(h, h_kv)
     sm_scale = 1.0 / (d**0.5)
     num_k_blocks = sk // block_k
     has_segments = qseg is not None
@@ -455,8 +474,8 @@ def _fwd_pallas(q, k, v, qseg, kseg, causal, window, block_q, block_k,
 
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (kv_row(bh), kj, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (kv_row(bh), kj, 0)),
     ]
     operands = [qr, kr, vr]
     if has_segments:
@@ -500,6 +519,8 @@ def _bwd_pallas(
 
     b, sq, h, d = q.shape
     sk = k.shape[1]
+    h_kv = k.shape[2]
+    kv_row = _kv_row(h, h_kv)
     sm_scale = 1.0 / (d**0.5)
     num_q_blocks = sq // block_q
     num_k_blocks = sk // block_k
@@ -520,8 +541,8 @@ def _bwd_pallas(
 
     dq_in_specs = [
         pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (kv_row(bh), kj, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (kv_row(bh), kj, 0)),
     ]
     dq_operands = [qr, kr, vr]
     if has_segments:
@@ -559,30 +580,49 @@ def _bwd_pallas(
         interpret=interpret,
     )(*dq_operands)
 
+    # GQA-aware grid: one row per KV head; the innermost "arbitrary" dim
+    # sweeps the q-head group × q-blocks (group-major), so the whole
+    # group's gradient accumulates in the f32 VMEM scratch and each dk/dv
+    # block has exactly one writer — no q-head-granularity HBM temporaries.
+    group = h // h_kv
+    total_q_iters = group * num_q_blocks
+
+    def q_row(g0, g2):
+        # folded q row for kv-head row g0 at inner iteration g2
+        return (g0 // h_kv) * h + (g0 % h_kv) * group + g2 // num_q_blocks
+
+    def q_blk(g2):
+        return g2 % num_q_blocks
+
     dkv_in_specs = [
-        pl.BlockSpec((1, block_q, d), lambda bh, kj, qi: (bh, qi, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bh, kj, qi: (bh, kj, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bh, kj, qi: (bh, kj, 0)),
+        pl.BlockSpec((1, block_q, d),
+                     lambda g0, g1, g2: (q_row(g0, g2), q_blk(g2), 0)),
+        pl.BlockSpec((1, block_k, d), lambda g0, g1, g2: (g0, g1, 0)),
+        pl.BlockSpec((1, block_k, d), lambda g0, g1, g2: (g0, g1, 0)),
     ]
     dkv_operands = [qr, kr, vr]
     if has_segments:
         # Transposed layouts for the transposed kernel: qseg
-        # sublane-replicated row, kseg lane-replicated column.
+        # sublane-replicated row, kseg lane-replicated column. Batch
+        # decodes from the kv-head-major grid row.
         dkv_in_specs += [
             pl.BlockSpec(
                 (1, _SUBLANES, block_q),
-                lambda g0, g1, g2: (g0 // h, 0, g2),
+                lambda g0, g1, g2: (g0 // h_kv, 0, q_blk(g2)),
             ),
             pl.BlockSpec(
                 (1, block_k, _LANES),
-                lambda g0, g1, g2: (g0 // h, g1, 0),
+                lambda g0, g1, g2: (g0 // h_kv, g1, 0),
             ),
         ]
         dkv_operands += [_as_row(qseg), _as_col(kseg)]
     dkv_in_specs += [
-        pl.BlockSpec((1, block_q, d), lambda bh, kj, qi: (bh, qi, 0)),
-        _row_spec(block_q, lambda g1, g2: g2),
-        _row_spec(block_q, lambda g1, g2: g2),
+        pl.BlockSpec((1, block_q, d),
+                     lambda g0, g1, g2: (q_row(g0, g2), q_blk(g2), 0)),
+        pl.BlockSpec((1, _SUBLANES, block_q),
+                     lambda g0, g1, g2: (q_row(g0, g2), 0, q_blk(g2))),
+        pl.BlockSpec((1, _SUBLANES, block_q),
+                     lambda g0, g1, g2: (q_row(g0, g2), 0, q_blk(g2))),
     ]
     dkv_operands += [dor, lse_row, dterm_row]
 
@@ -596,16 +636,17 @@ def _bwd_pallas(
             block_q=block_q,
             block_k=block_k,
             num_q_blocks=num_q_blocks,
+            total_q_iters=total_q_iters,
         ),
-        grid=(b * h, num_k_blocks, num_q_blocks),
+        grid=(b * h_kv, num_k_blocks, total_q_iters),
         in_specs=dkv_in_specs,
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda bh, kj, qi: (bh, kj, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, kj, qi: (bh, kj, 0)),
+            pl.BlockSpec((1, block_k, d), lambda g0, g1, g2: (g0, g1, 0)),
+            pl.BlockSpec((1, block_k, d), lambda g0, g1, g2: (g0, g1, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+            jax.ShapeDtypeStruct((b * h_kv, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h_kv, sk, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -619,8 +660,8 @@ def _bwd_pallas(
 
     return (
         _unfold_heads(dq, b, h),
-        _unfold_heads(dk, b, h),
-        _unfold_heads(dv, b, h),
+        _unfold_heads(dk, b, h_kv),
+        _unfold_heads(dv, b, h_kv),
     )
 
 
@@ -732,6 +773,16 @@ def _check_window(window, causal):
 def _prepare(q, k, v, block_q, block_k, interpret):
     b, sq, h, d = q.shape
     sk = k.shape[1]
+    h_kv = k.shape[2]
+    if v.shape[2] != h_kv:
+        raise ValueError(
+            f"k and v head counts differ: {h_kv} vs {v.shape[2]}"
+        )
+    if h % h_kv:
+        raise ValueError(
+            f"query head count {h} must be a multiple of the kv head "
+            f"count {h_kv} (grouped-query attention)"
+        )
     if block_q is None:
         block_q = _auto_block(sq, _BLOCK_Q_CAP)
     if block_k is None:
@@ -781,6 +832,11 @@ def flash_attention(
     position i attends keys in ``(i-window, i]`` only; tiles entirely
     outside the band are skipped, so compute is O(seq·window) not
     O(seq²). Requires ``causal=True``.
+
+    Grouped-query attention: ``k``/``v`` may carry fewer heads than ``q``
+    (``h % h_kv == 0``); each query head attends its group's kv head
+    (Llama/Mistral GQA, MQA at ``h_kv=1``), with dK/dV group-summed in the
+    backward.
     """
     window = _check_window(window, causal)
     block_q, block_k, interpret = _prepare(q, k, v, block_q, block_k, interpret)
