@@ -4,4 +4,5 @@ from .flash_attention import (  # noqa: F401
     flash_attention,
     flash_attention_fn,
     flash_attention_with_lse,
+    padding_to_segment_ids,
 )
