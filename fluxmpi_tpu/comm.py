@@ -28,6 +28,7 @@ reference's CPU-staging fallback, src/mpi_extensions.jl:97-106).
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import Any, Callable
 
 import numpy as np
@@ -167,7 +168,7 @@ def unshard_ranks(x: jax.Array) -> np.ndarray:
 
 @functools.lru_cache(maxsize=None)
 def _collective_fn(
-    mesh: Mesh, axis: str, kind: str, op: str, root: int
+    mesh: Mesh, axis: str, kind: str, op: str, root: int, donate: bool
 ) -> Callable[[jax.Array], jax.Array]:
     spec = P(axis)
 
@@ -189,7 +190,11 @@ def _collective_fn(
         raise AssertionError(kind)
 
     fn = shard_map(body, mesh=mesh, in_specs=(spec,), out_specs=spec)
-    return jax.jit(fn)
+    # Donation lets XLA write the reduction into the input buffer — the
+    # zero-copy analogue of the reference's in-place ``allreduce!``
+    # (src/mpi_extensions.jl:97-111). Input and output share one sharding,
+    # so the aliasing is always representable.
+    return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 
 def _host_collective(
@@ -216,6 +221,7 @@ def _run_collective(
     root: int = 0,
     mesh: Mesh | None = None,
     axis_name: str | None = None,
+    donate: bool = False,
 ) -> jax.Array:
     mesh = mesh or global_mesh()
     name, size = _axis_and_size(mesh, axis_name)
@@ -230,7 +236,29 @@ def _run_collective(
             )
         return _host_collective(xs, kind, op, root, mesh, name)
     xs = shard_ranks(x, mesh, name)
-    fn = _collective_fn(mesh, name, kind, op, root)
+    # Host (non-jax.Array) inputs are staged into a buffer that is provably
+    # ours alone — donate it so the collective writes in place instead of
+    # allocating a second output buffer. Device-array inputs are only
+    # consumed on explicit ``donate=True`` (the reference's mutating
+    # ``allreduce!`` contract): device_put can return a NEW Array object
+    # that still aliases the caller's buffers (e.g. a layout-identical but
+    # non-``==`` sharding spec), so object identity of the staged array
+    # cannot prove a private copy.
+    fresh = not isinstance(x, jax.Array)
+    if donate and not fresh and not x.sharding.is_equivalent_to(
+        xs.sharding, x.ndim
+    ):
+        # The staging device_put materialized a reshard; donating that copy
+        # frees nothing the caller owns, so the promised in-place behavior
+        # silently degrades — say so instead.
+        warnings.warn(
+            "donate=True on a device array that required resharding: the "
+            "staged copy is donated but the caller's buffer stays live "
+            "(no in-place reuse). Pre-shard with shard_ranks() to get "
+            "zero-copy collectives.",
+            stacklevel=3,
+        )
+    fn = _collective_fn(mesh, name, kind, op, root, donate or fresh)
     return fn(xs)
 
 
@@ -245,6 +273,7 @@ def allreduce(
     *,
     mesh: Mesh | None = None,
     axis_name: str | None = None,
+    donate: bool = False,
 ) -> jax.Array:
     """All-reduce a per-worker value: every worker's slice becomes the
     reduction of all workers' slices.
@@ -252,8 +281,20 @@ def allreduce(
     Analogue of ``allreduce!`` (reference: src/mpi_extensions.jl:97-111),
     lowered to an XLA AllReduce over ICI instead of ``MPI.Allreduce!``.
     ``x`` has leading axis == world size (one slice per worker).
+
+    ``donate=True`` reproduces the reference's in-place contract: the input
+    buffer is handed to XLA for reuse as the output (zero extra copies) and
+    ``x`` must not be used afterwards. Host (numpy) inputs are staged into a
+    private buffer that is always donated; device-array inputs — even ones
+    that need resharding — are never consumed without the flag, because a
+    staging ``device_put`` may alias the caller's buffers. With
+    ``donate=True``, in-place reuse of the *caller's* buffer only happens
+    when ``x`` is already laid out as :func:`shard_ranks` would place it;
+    a reshard-staged input donates only the staging copy (warned).
     """
-    return _run_collective(x, "allreduce", _canonical_op(op), 0, mesh, axis_name)
+    return _run_collective(
+        x, "allreduce", _canonical_op(op), 0, mesh, axis_name, donate
+    )
 
 
 def bcast(
@@ -262,13 +303,16 @@ def bcast(
     *,
     mesh: Mesh | None = None,
     axis_name: str | None = None,
+    donate: bool = False,
 ) -> jax.Array:
     """Broadcast the root worker's slice to all workers.
 
     Analogue of ``bcast!`` (reference: src/mpi_extensions.jl:119-133), lowered
     to XLA all-gather + slice (collective-broadcast) instead of ``MPI.Bcast!``.
+    ``donate=True`` consumes an already-sharded input in place (see
+    :func:`allreduce`).
     """
-    return _run_collective(x, "bcast", "sum", root, mesh, axis_name)
+    return _run_collective(x, "bcast", "sum", root, mesh, axis_name, donate)
 
 
 def reduce(
@@ -278,15 +322,19 @@ def reduce(
     *,
     mesh: Mesh | None = None,
     axis_name: str | None = None,
+    donate: bool = False,
 ) -> jax.Array:
     """Reduce to the root worker: root's slice becomes the reduction, other
     workers keep their input slice.
 
     Analogue of ``reduce!`` (reference: src/mpi_extensions.jl:141-155). On ICI
     an all-reduce is as cheap as a rooted reduce, so this lowers to
-    all-gather + local reduce masked to the root.
+    all-gather + local reduce masked to the root. ``donate=True`` consumes an
+    already-sharded input in place (see :func:`allreduce`).
     """
-    return _run_collective(x, "reduce", _canonical_op(op), root, mesh, axis_name)
+    return _run_collective(
+        x, "reduce", _canonical_op(op), root, mesh, axis_name, donate
+    )
 
 
 class Request:

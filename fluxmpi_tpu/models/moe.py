@@ -30,6 +30,7 @@ onto the MXU:
 
 from __future__ import annotations
 
+import warnings
 from typing import Any
 
 import flax.linen as nn
@@ -38,6 +39,10 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .transformer import EncoderBlock, TransformerEncoder, TransformerLM
+
+# One warning per (pin-spec, dim, mesh-extent) triple process-wide: _pin runs
+# inside traced layers, so a per-call warning would fire every recompile.
+_WARNED_SKIPPED_PINS: set = set()
 
 __all__ = [
     "MoEMLP",
@@ -112,7 +117,21 @@ class MoEMLP(nn.Module):
                 total *= self.mesh.shape[a]
             if not axes or x.shape[i] % total:
                 # Dim not divisible by the mesh axes (tiny debug batches):
-                # leave the partitioner free rather than fail the trace.
+                # leave the partitioner free rather than fail the trace —
+                # but say so once, because a silently skipped pin means the
+                # expert all-to-all degrades to the weight-all-gather
+                # lowering the pin exists to prevent (ADVICE r3).
+                if axes:
+                    key = (d, i, x.shape[i], total)
+                    if key not in _WARNED_SKIPPED_PINS:
+                        _WARNED_SKIPPED_PINS.add(key)
+                        warnings.warn(
+                            f"MoE sharding pin {d!r} skipped: dim {i} of "
+                            f"shape {tuple(x.shape)} is not divisible by "
+                            f"mesh extent {total}; the partitioner may fall "
+                            f"back to an all-gather lowering",
+                            stacklevel=3,
+                        )
                 spec.append(free)
                 continue
             spec.append(axes if len(axes) > 1 else axes[0])

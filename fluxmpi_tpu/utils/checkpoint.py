@@ -23,7 +23,9 @@ import os
 import re
 import shutil
 import threading
+import warnings
 from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as _FutureTimeout
 from typing import Any
 
 import jax
@@ -38,6 +40,28 @@ def _checkpointer():
     import orbax.checkpoint as ocp
 
     return ocp.PyTreeCheckpointer()
+
+
+def _wait_with_diagnostic(
+    fut: Future, what: str, warn_after_s: float = 60.0
+) -> None:
+    """``fut.result()`` that surfaces a wedge instead of hanging silently:
+    a background save that never completes (e.g. one process missing a
+    cross-process barrier) cannot be forced to finish, but the periodic
+    warning turns an inexplicable hang into a diagnosable one (ADVICE r3)."""
+    waited = 0.0
+    while True:
+        try:
+            fut.result(timeout=warn_after_s)
+            return
+        except _FutureTimeout:
+            waited += warn_after_s
+            warnings.warn(
+                f"{what} has not completed after {waited:.0f}s — possible "
+                f"cross-process barrier wedge (a peer process may have "
+                f"exited or diverged); still waiting",
+                stacklevel=2,
+            )
 
 
 def _process_barrier(name: str) -> None:
@@ -299,13 +323,21 @@ class CheckpointManager:
             else x,
             state,
         )
+        # Submit under the lock so wait_until_finished always observes the
+        # newest pending future; the single-worker executor runs saves in
+        # submission order regardless. The wait on the *previous* save
+        # happens OUTSIDE the lock: if a background save wedges (e.g. one
+        # process never reaches a cross-process barrier), a lock-held wait
+        # would deadlock wait_until_finished behind it too (ADVICE r3). The
+        # post-submit wait still throttles to one queued snapshot and
+        # surfaces the previous save's errors to this caller.
         with self._lock:
             prev = self._pending
-            if prev is not None:
-                prev.result()  # surface errors; keep cross-process order
             self._pending = self._executor.submit(
                 self._save_and_retain, step, snapshot, force
             )
+        if prev is not None:
+            _wait_with_diagnostic(prev, "previous async checkpoint save")
 
     def _save_and_retain(self, step: int, state: Any, force: bool) -> None:
         save_checkpoint(self._step_path(step), state, force=force)
@@ -331,7 +363,7 @@ class CheckpointManager:
             pending = self._pending
             self._pending = None
         if pending is not None:
-            pending.result()
+            _wait_with_diagnostic(pending, "in-flight async checkpoint save")
 
     def restore(self, like: Any, *, step: int | None = None) -> tuple[int, Any]:
         """Restore ``step`` (default: latest complete) as

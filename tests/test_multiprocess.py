@@ -19,7 +19,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.parametrize("nprocs", [2, 4])
+@pytest.mark.parametrize("nprocs", [2, 3, 4, 8])
 def test_process_world(nprocs, tmp_path):
     """Spawn an nprocs jax.distributed world running the full worker suite:
     identity, host collectives, synchronize, eager gradient allreduce, a
