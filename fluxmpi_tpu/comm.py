@@ -228,6 +228,16 @@ def _run_collective(
     if not 0 <= root < size:
         raise ValueError(f"root rank {root} out of range for world size {size}")
     if config.DEVICE_COLLECTIVES_DISABLED:
+        if donate:
+            # The host-staging debug path round-trips through numpy; there
+            # is no buffer to reuse. Same silent-degradation signal as the
+            # reshard case below.
+            warnings.warn(
+                "donate=True has no effect with device collectives "
+                "disabled: the host-staging path copies through numpy "
+                "(no in-place reuse)",
+                stacklevel=3,
+            )
         xs = jnp.asarray(x)
         if xs.ndim == 0 or xs.shape[0] != size:
             raise ValueError(

@@ -56,6 +56,23 @@ __all__ = [
 _NEG_INF = -1e30
 
 
+def _expand_kv(t, h):
+    """Repeat grouped K/V heads up to the query head count for the dense
+    math paths. The flash kernel reads grouped heads natively (its
+    ``_kv_row`` index map); only the dense fallback materializes the
+    repeat — and only *locally*, after any ring rotation, so the ICI hops
+    still move the small ``h_kv`` blocks (the point of GQA on the ring)."""
+    h_kv = t.shape[2]
+    if h_kv == h:
+        return t
+    if h % h_kv:
+        raise ValueError(
+            f"query head count {h} must be a multiple of the kv head "
+            f"count {h_kv} (grouped-query attention)"
+        )
+    return jnp.repeat(t, h // h_kv, axis=2)
+
+
 def _block_attend(q, k, v, o, m, l, mask):
     """One blockwise online-softmax update.
 
@@ -91,25 +108,40 @@ def _seg_mask4(qseg, kseg):
     return (q4 == k4) & (k4 != 0)
 
 
-def _dense_with_lse(q, k, v, causal):
+def _dense_with_lse(q, k, v, causal, qseg=None, kseg=None):
     """Dense local attend returning (normalized out [b,sq,h,d] f32,
     lse [b,h,sq] f32) — the non-Pallas twin of flash_attention_with_lse,
-    used by the zigzag schedule's CPU/debug path."""
+    used by the zigzag schedule's CPU/debug path. Handles grouped K/V
+    heads (repeated locally) and optional segment ids."""
+    k = _expand_kv(k, q.shape[2])
+    v = _expand_kv(v, q.shape[2])
     scale = 1.0 / np.sqrt(q.shape[-1])
     s = jnp.einsum(
         "bqhd,bkhd->bhqk",
         q.astype(jnp.float32),
         k.astype(jnp.float32),
     ) * scale
+    mask = None
     if causal:
         sq, sk = q.shape[1], k.shape[1]
-        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
-        s = jnp.where(mask[None, None], s, _NEG_INF)
+        mask = (
+            jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        )[None, None]
+    if qseg is not None:
+        smask = _seg_mask4(qseg, kseg)
+        mask = smask if mask is None else jnp.logical_and(mask, smask)
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_INF)
     m = jnp.max(s, axis=-1)  # [b, h, sq]
     p = jnp.exp(s - m[..., None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
     l = jnp.sum(p, axis=-1)
-    lse = m + jnp.log(jnp.where(l == 0.0, 1.0, l))
-    o = jnp.einsum("bhqk,bkhd->bqhd", p / l[..., None], v.astype(jnp.float32))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    lse = m + jnp.log(l_safe)
+    o = jnp.einsum(
+        "bhqk,bkhd->bqhd", p / l_safe[..., None], v.astype(jnp.float32)
+    )
     return o, lse
 
 
@@ -210,6 +242,8 @@ def _local_attend(
     qseg, kseg = _normalize_ring_segments(
         segment_ids, q.shape[0], q.shape[1], k.shape[1]
     )
+    k = _expand_kv(k, q.shape[2])
+    v = _expand_kv(v, q.shape[2])
     mask = None
     if causal:
         sq, sk = q.shape[1], k.shape[1]
@@ -308,8 +342,10 @@ def ring_attention(
         # After s rotations, the resident block originated on ring position
         # (idx - s) mod n.
         src = (idx - s) % n
-        kf = k_blk.astype(jnp.float32)
-        vf = v_blk.astype(jnp.float32)
+        # GQA: the rotating blocks keep their h_kv heads (small ICI hops);
+        # the repeat to h query heads happens locally, post-rotation.
+        kf = _expand_kv(k_blk, h).astype(jnp.float32)
+        vf = _expand_kv(v_blk, h).astype(jnp.float32)
         mask = None
         if causal:
             q_pos = idx * sq + jnp.arange(sq)
@@ -371,6 +407,7 @@ def zigzag_ring_attention(
     v: jnp.ndarray,
     *,
     axis_name: str | None = None,
+    segment_ids=None,
     use_flash: bool = False,
     block_q: int | None = None,
     block_k: int | None = None,
@@ -385,9 +422,18 @@ def zigzag_ring_attention(
     skips n-1 of its n ticks. Total work is the causal ideal, half of the
     non-causal ring. Schedule spec: :func:`zigzag_tick_work`.
 
-    Segment masking is not supported here (the chunk permutation would also
-    permute segment boundaries); use :func:`ring_attention` for packed or
-    padded batches.
+    ``segment_ids``: optional int32 local shards ``[batch, seq_local]`` in
+    the flash-kernel convention (attend iff ids equal, key id 0 = padding),
+    **pre-permuted with the same** :func:`zigzag_indices` **as q/k/v** so
+    each id rides with its token. They split into the same (lo, hi) chunks
+    as Q and rotate around the ring with their K/V blocks, so packed and
+    padded batches get the balanced causal schedule too
+    (:func:`make_ring_attention` with ``schedule="zigzag"`` does the
+    permutation for you).
+
+    Grouped-query attention: K/V may carry fewer heads than Q; the rotating
+    blocks stay at ``h_kv`` heads (smaller ICI hops) and the flash kernel
+    reads them natively.
     """
     from ..ops.flash_attention import flash_attention_with_lse
 
@@ -398,28 +444,32 @@ def zigzag_ring_attention(
         # Unbound axis (module.init outside shard_map): n=1 zigzag layout
         # is the identity permutation, so plain causal attention is exact.
         return _local_attend(
-            q, k, v, causal=True, use_flash=use_flash,
-            block_q=block_q, block_k=block_k,
+            q, k, v, causal=True, segment_ids=segment_ids,
+            use_flash=use_flash, block_q=block_q, block_k=block_k,
         )
     idx = jax.lax.axis_index(name)
     b, sq, h, d = q.shape
     if sq % 2:
         raise ValueError(f"local sequence length {sq} must be even (2 chunks)")
     c = sq // 2
+    qseg, kseg = _normalize_ring_segments(segment_ids, b, sq, k.shape[1])
+    has_seg = qseg is not None
 
-    def attend(qc, kc, vc, local_causal):
+    def attend(qc, kc, vc, local_causal, qs=None, ks=None):
+        seg = (qs, ks) if qs is not None else None
         if use_flash:
             return flash_attention_with_lse(
-                qc, kc, vc, causal=local_causal,
+                qc, kc, vc, causal=local_causal, segment_ids=seg,
                 block_q=None if block_q is None else min(block_q, c),
                 block_k=None if block_k is None else min(block_k, c),
             )
-        return _dense_with_lse(qc, kc, vc, local_causal)
+        return _dense_with_lse(qc, kc, vc, local_causal, qs, ks)
 
     def split(t):
         return t[:, :c], t[:, c:]
 
     q_lo, q_hi = split(q)
+    qseg_lo, qseg_hi = split(qseg) if has_seg else (None, None)
 
     o_lo = jnp.zeros((b, c, h, d), jnp.float32)
     o_hi = jnp.zeros((b, c, h, d), jnp.float32)
@@ -429,26 +479,36 @@ def zigzag_ring_attention(
     # Tick 0 — resident KV is our own pair: zigzag_tick_work(i, 0, n).
     kv_lo_k, kv_hi_k = split(k)
     kv_lo_v, kv_hi_v = split(v)
-    o_blk, lse_blk = attend(q_lo, kv_lo_k, kv_lo_v, True)  # (lo, lo, diag)
+    ks_lo, ks_hi = split(kseg) if has_seg else (None, None)
+    o_blk, lse_blk = attend(
+        q_lo, kv_lo_k, kv_lo_v, True, qseg_lo, ks_lo
+    )  # (lo, lo, diag)
     o_lo, lse_lo = _lse_merge(o_lo, lse_lo, o_blk, lse_blk)
-    o_blk, lse_blk = attend(q_hi, kv_lo_k, kv_lo_v, False)  # (hi, lo, full)
+    o_blk, lse_blk = attend(
+        q_hi, kv_lo_k, kv_lo_v, False, qseg_hi, ks_lo
+    )  # (hi, lo, full)
     o_hi, lse_hi = _lse_merge(o_hi, lse_hi, o_blk, lse_blk)
-    o_blk, lse_blk = attend(q_hi, kv_hi_k, kv_hi_v, True)  # (hi, hi, diag)
+    o_blk, lse_blk = attend(
+        q_hi, kv_hi_k, kv_hi_v, True, qseg_hi, ks_hi
+    )  # (hi, hi, diag)
     o_hi, lse_hi = _lse_merge(o_hi, lse_hi, o_blk, lse_blk)
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def body(s, carry):
-        o_lo, lse_lo, o_hi, lse_hi, k_blk, v_blk = carry
+        o_lo, lse_lo, o_hi, lse_hi, k_blk, v_blk, kseg_blk = carry
         k_blk = jax.lax.ppermute(k_blk, name, perm)
         v_blk = jax.lax.ppermute(v_blk, name, perm)
+        if has_seg:
+            kseg_blk = jax.lax.ppermute(kseg_blk, name, perm)
         src = (idx - s) % n
         klo, khi = split(k_blk)
         vlo, vhi = split(v_blk)
+        kslo, kshi = split(kseg_blk) if has_seg else (None, None)
 
         # Always: (hi, lo, full) — q_hi = chunk 2n-1-idx is in the future of
         # every lo chunk src < n.
-        o_blk, lse_blk = attend(q_hi, klo, vlo, False)
+        o_blk, lse_blk = attend(q_hi, klo, vlo, False, qseg_hi, kslo)
         o_hi, lse_hi = _lse_merge(o_hi, lse_hi, o_blk, lse_blk)
 
         # Predicate-selected second attend: src < idx → (lo, lo, full);
@@ -459,17 +519,20 @@ def zigzag_ring_attention(
         q_sel = jnp.where(pred, q_lo, q_hi)
         k_sel = jnp.where(pred, klo, khi)
         v_sel = jnp.where(pred, vlo, vhi)
-        o_blk, lse_blk = attend(q_sel, k_sel, v_sel, False)
+        qs_sel = jnp.where(pred, qseg_lo, qseg_hi) if has_seg else None
+        ks_sel = jnp.where(pred, kslo, kshi) if has_seg else None
+        o_blk, lse_blk = attend(q_sel, k_sel, v_sel, False, qs_sel, ks_sel)
         new_lo = _lse_merge(o_lo, lse_lo, o_blk, lse_blk)
         new_hi = _lse_merge(o_hi, lse_hi, o_blk, lse_blk)
         o_lo = jnp.where(pred, new_lo[0], o_lo)
         lse_lo = jnp.where(pred, new_lo[1], lse_lo)
         o_hi = jnp.where(pred, o_hi, new_hi[0])
         lse_hi = jnp.where(pred, lse_hi, new_hi[1])
-        return o_lo, lse_lo, o_hi, lse_hi, k_blk, v_blk
+        return o_lo, lse_lo, o_hi, lse_hi, k_blk, v_blk, kseg_blk
 
-    o_lo, lse_lo, o_hi, lse_hi, _, _ = jax.lax.fori_loop(
-        1, n, body, (o_lo, lse_lo, o_hi, lse_hi, k, v)
+    kseg0 = kseg if has_seg else jnp.zeros((), jnp.int32)
+    o_lo, lse_lo, o_hi, lse_hi, _, _, _ = jax.lax.fori_loop(
+        1, n, body, (o_lo, lse_lo, o_hi, lse_hi, k, v, kseg0)
     )
     return jnp.concatenate([o_lo, o_hi], axis=1).astype(q.dtype)
 
@@ -546,24 +609,33 @@ def make_ring_attention(
     spec = P(dp, sp)
 
     if schedule == "zigzag":
-        def body(q, k, v):
+        def body(q, k, v, *seg):
             return zigzag_ring_attention(
                 q, k, v, axis_name=sp, use_flash=use_flash,
+                segment_ids=seg if seg else None,
                 block_q=block_q, block_k=block_k,
             )
     else:
-        def body(q, k, v):
+        def body(q, k, v, *seg):
             return ring_attention(
                 q, k, v, axis_name=sp, causal=causal, use_flash=use_flash,
+                segment_ids=seg if seg else None,
                 block_q=block_q, block_k=block_k,
             )
 
-    mapped = shard_map_unchecked(
-        body, mesh, in_specs=(spec, spec, spec), out_specs=spec
-    )
-    jitted = jax.jit(mapped)
+    jitted_by_nseg: dict = {}
 
-    def fn(q, k, v):
+    def _jitted(n_seg: int):
+        # One shard_map per arity: segment operands are extra sharded
+        # inputs, so the mapped signature differs with/without them.
+        if n_seg not in jitted_by_nseg:
+            specs = (spec,) * (3 + n_seg)
+            jitted_by_nseg[n_seg] = jax.jit(shard_map_unchecked(
+                body, mesh, in_specs=specs, out_specs=spec
+            ))
+        return jitted_by_nseg[n_seg]
+
+    def fn(q, k, v, segment_ids=None):
         size = mesh.shape[sp]
         divisor = 2 * size if schedule == "zigzag" else size
         for name_, t in (("q", q), ("k", k), ("v", v)):
@@ -574,14 +646,30 @@ def make_ring_attention(
                     + (", ×2 chunks for zigzag)" if schedule == "zigzag"
                        else ") — pad the sequence")
                 )
+        if segment_ids is None:
+            segs = ()
+        elif isinstance(segment_ids, (tuple, list)):
+            segs = tuple(jnp.asarray(s, jnp.int32) for s in segment_ids)
+        else:
+            segs = (jnp.asarray(segment_ids, jnp.int32),) * 2
+        for s, ref in zip(segs, (q, k)):
+            # Must match here, before the zigzag gather — JAX clamps
+            # out-of-bounds gather indices, so a short segment array would
+            # silently duplicate its tail instead of erroring.
+            if s.shape != (ref.shape[0], ref.shape[1]):
+                raise ValueError(
+                    f"segment_ids shape {s.shape} != (batch, seq) = "
+                    f"{(ref.shape[0], ref.shape[1])}"
+                )
         sharding = NamedSharding(mesh, spec)
         if schedule == "zigzag":
             idxs = zigzag_indices(q.shape[1], size)
             inv = np.argsort(idxs)
             q, k, v = (jnp.asarray(t)[:, idxs] for t in (q, k, v))
-            q, k, v = (jax.device_put(t, sharding) for t in (q, k, v))
-            return jitted(q, k, v)[:, inv]
-        q, k, v = (jax.device_put(t, sharding) for t in (q, k, v))
-        return jitted(q, k, v)
+            segs = tuple(s[:, idxs] for s in segs)
+            args = [jax.device_put(t, sharding) for t in (q, k, v, *segs)]
+            return _jitted(len(segs))(*args)[:, inv]
+        args = [jax.device_put(t, sharding) for t in (q, k, v, *segs)]
+        return _jitted(len(segs))(*args)
 
     return fn

@@ -60,6 +60,12 @@ def ulysses_attention(
     ids equal and key id nonzero, 0 = padding); they are all-gathered to
     the full sequence for the local attend (O(b·s) int32 — negligible).
 
+    Grouped-query attention: K/V may carry fewer heads than Q
+    (``h % h_kv == 0``); each tensor's own head axis is all-to-all'd, so
+    both ``h`` and ``h_kv`` must divide the axis size. The local shard
+    preserves the exact GQA group structure and moves ``h_kv/h`` of the
+    full-head K/V bytes.
+
     Outside a bound axis (e.g. ``module.init``) this degrades to exact
     single-device attention, like the ring.
     """
@@ -72,10 +78,23 @@ def ulysses_attention(
             use_flash=use_flash, block_q=block_q, block_k=block_k,
         )
     b, s_local, h, d = q.shape
+    h_kv = k.shape[2]
     if h % n:
         raise ValueError(
             f"head count {h} must be divisible by the '{name}' axis size "
             f"{n} (Ulysses shards heads; use ring_attention otherwise)"
+        )
+    if h_kv != h and h_kv % n:
+        # GQA: K/V carry h_kv < h heads. The all-to-all shards each
+        # tensor's own head axis, so h_kv must divide too; the local shard
+        # then keeps the exact group structure (local q head g attends
+        # local kv head g // (h/h_kv)) and the flash kernel reads it
+        # natively. (ADVICE r3: this used to surface as an opaque
+        # all_to_all shape error.)
+        raise ValueError(
+            f"kv head count {h_kv} must be divisible by the '{name}' axis "
+            f"size {n} (Ulysses shards kv heads too; use ring_attention "
+            f"for grouped-KV layouts with fewer heads than devices)"
         )
 
     def seq_to_heads(t):

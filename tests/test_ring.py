@@ -499,6 +499,246 @@ def test_ulysses_rejects_indivisible_heads(sp_mesh):
         fn(q, k, v)
 
 
+# ---- grouped-query attention through the SP layers (VERDICT r3 next #5) ----
+
+
+def _gqa_qkv(batch=2, seq=64, heads=8, kv_heads=2, dim=16, seed=30):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(batch, seq, heads, dim)).astype(np.float32))
+    k = jnp.asarray(
+        rng.normal(size=(batch, seq, kv_heads, dim)).astype(np.float32)
+    )
+    v = jnp.asarray(
+        rng.normal(size=(batch, seq, kv_heads, dim)).astype(np.float32)
+    )
+    return q, k, v
+
+
+def _repeat_kv(t, h):
+    return jnp.repeat(t, h // t.shape[2], axis=2)
+
+
+@pytest.mark.parametrize("use_flash", [False, True])
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_gqa_matches_dense(sp_mesh, causal, use_flash):
+    # GQA on the contiguous ring: the rotating K/V blocks keep their h_kv
+    # heads (the ICI saving is the point); output matches dense attention
+    # on repeated KV heads.
+    from fluxmpi_tpu.parallel.ring import make_ring_attention
+
+    q, k, v = _gqa_qkv(seed=30)
+    fn = make_ring_attention(
+        sp_mesh, axis_name="sp", causal=causal, use_flash=use_flash,
+        block_q=8, block_k=8,
+    )
+    out = fn(q, k, v)
+    expected = _dense_attention(
+        q, _repeat_kv(k, 8), _repeat_kv(v, 8), causal=causal
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5)
+
+
+@pytest.mark.parametrize("use_flash", [False, True])
+def test_zigzag_gqa_matches_dense(sp_mesh, use_flash):
+    from fluxmpi_tpu.parallel.ring import make_ring_attention
+
+    q, k, v = _gqa_qkv(seed=31)
+    fn = make_ring_attention(
+        sp_mesh, axis_name="sp", causal=True, use_flash=use_flash,
+        schedule="zigzag", block_q=4, block_k=4,
+    )
+    out = fn(q, k, v)
+    expected = _dense_attention(
+        q, _repeat_kv(k, 8), _repeat_kv(v, 8), causal=True
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5)
+
+
+def test_ring_gqa_grad_matches_dense(sp_mesh):
+    # dK/dV must arrive group-summed, exactly as differentiating the
+    # repeated-KV dense formulation produces.
+    from jax.sharding import PartitionSpec as P
+
+    from fluxmpi_tpu.parallel.ring import ring_attention
+
+    q, k, v = _gqa_qkv(seq=32, seed=32)
+
+    def per_device(q, k, v):
+        out = ring_attention(q, k, v, axis_name="sp", causal=True,
+                             use_flash=True, block_q=4, block_k=4)
+        return jax.lax.psum(jnp.sum(jnp.sin(out)), "sp")
+
+    mapped = _sm()(
+        per_device,
+        mesh=sp_mesh,
+        in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
+        out_specs=P(),
+        check_vma=False,
+    )
+    gf = jax.jit(jax.grad(lambda q, k, v: mapped(q, k, v), argnums=(0, 1, 2)))(
+        q, k, v
+    )
+
+    def loss_dense(q, k, v):
+        out = _dense_attention(q, _repeat_kv(k, 8), _repeat_kv(v, 8),
+                               causal=True)
+        return jnp.sum(jnp.sin(out))
+
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+@pytest.mark.parametrize("use_flash", [False, True])
+def test_ulysses_gqa_matches_dense(sp_mesh, use_flash):
+    # Ulysses GQA: each tensor's own head axis is all-to-all'd (8 q heads
+    # and 8 kv heads won't both fit sp=8 with h_kv=2 — use a 2-device
+    # submesh so h=8, h_kv=2 both divide).
+    from jax.sharding import Mesh
+
+    from fluxmpi_tpu.parallel import make_ulysses_attention
+
+    mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(2), ("sp",))
+    q, k, v = _gqa_qkv(seed=33)
+    fn = make_ulysses_attention(
+        mesh, axis_name="sp", causal=True, use_flash=use_flash
+    )
+    out = fn(q, k, v)
+    expected = _dense_attention(
+        q, _repeat_kv(k, 8), _repeat_kv(v, 8), causal=True
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5)
+
+
+def test_ulysses_gqa_grad_matches_dense(world):
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from fluxmpi_tpu.parallel import ulysses_attention
+
+    mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(2), ("sp",))
+    q, k, v = _gqa_qkv(seq=32, seed=34)
+
+    def per_device(q, k, v):
+        out = ulysses_attention(q, k, v, axis_name="sp", causal=True)
+        return jax.lax.psum(jnp.sum(jnp.sin(out)), "sp")
+
+    mapped = _sm()(
+        per_device,
+        mesh=mesh,
+        in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
+        out_specs=P(),
+        check_vma=False,
+    )
+    gf = jax.jit(jax.grad(lambda q, k, v: mapped(q, k, v), argnums=(0, 1, 2)))(
+        q, k, v
+    )
+
+    def loss_dense(q, k, v):
+        out = _dense_attention(q, _repeat_kv(k, 8), _repeat_kv(v, 8),
+                               causal=True)
+        return jnp.sum(jnp.sin(out))
+
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_ulysses_rejects_indivisible_kv_heads(sp_mesh):
+    # ADVICE r3: GQA inputs whose kv head count doesn't divide the axis
+    # used to die deep inside all_to_all with an opaque shape error.
+    from jax.sharding import PartitionSpec as P
+
+    from fluxmpi_tpu.parallel import ulysses_attention
+
+    q, k, v = _gqa_qkv(seed=35)  # h=8 divides sp=8; h_kv=2 does not
+
+    def per_device(q, k, v):
+        return ulysses_attention(q, k, v, axis_name="sp")
+
+    mapped = _sm()(
+        per_device,
+        mesh=sp_mesh,
+        in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
+        out_specs=P(None, "sp"),
+        check_vma=False,
+    )
+    with pytest.raises(ValueError, match="kv head count"):
+        jax.jit(mapped)(q, k, v)
+
+
+# ---- zigzag segment ids (VERDICT r3 next #4) ----
+
+
+@pytest.mark.parametrize("use_flash", [False, True])
+def test_zigzag_segments_match_dense(sp_mesh, use_flash):
+    # Packed + padded batch through the balanced causal schedule: segment
+    # ids ride the zigzag permutation with their tokens and rotate with
+    # the K/V blocks. Valid rows match the dense masked causal oracle.
+    from fluxmpi_tpu.parallel.ring import make_ring_attention
+
+    q, k, v = _qkv(seq=64, seed=36)
+    seg = np.ones((2, 64), np.int32)
+    seg[0, :24] = 1
+    seg[0, 24:56] = 2
+    seg[0, 56:] = 0  # pad tail
+    seg[1, :40] = 3
+    seg[1, 40:] = 4
+    seg = jnp.asarray(seg)
+
+    fn = make_ring_attention(
+        sp_mesh, axis_name="sp", causal=True, use_flash=use_flash,
+        schedule="zigzag", block_q=4, block_k=4,
+    )
+    out = fn(q, k, v, segment_ids=seg)
+    expected = _dense_seg_attention(q, k, v, seg, seg, causal=True)
+    ok = np.asarray(seg) != 0
+    np.testing.assert_allclose(
+        np.asarray(out)[ok], np.asarray(expected)[ok], atol=2e-5
+    )
+
+
+def test_zigzag_segments_grad_matches_dense(sp_mesh):
+    from jax.sharding import PartitionSpec as P
+
+    from fluxmpi_tpu.parallel.ring import zigzag_indices, zigzag_ring_attention
+
+    q, k, v = _qkv(seq=64, seed=37)
+    seg = np.ones((2, 64), np.int32)
+    seg[0, 32:] = 2
+    seg[1, 48:] = 0  # pad tail
+    seg = jnp.asarray(seg)
+    idxs = zigzag_indices(64, 8)
+
+    def per_device(q, k, v, seg):
+        out = zigzag_ring_attention(
+            q, k, v, axis_name="sp", segment_ids=seg, block_q=4, block_k=4
+        )
+        return jax.lax.psum(jnp.sum(jnp.sin(out)), "sp")
+
+    mapped = _sm()(
+        per_device,
+        mesh=sp_mesh,
+        in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp"), P(None, "sp")),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+    def loss_zigzag(q, k, v):
+        return mapped(q[:, idxs], k[:, idxs], v[:, idxs], seg[:, idxs])
+
+    def loss_dense(q, k, v):
+        out = _dense_seg_attention(q, k, v, seg, seg, causal=True)
+        # Padded rows produce garbage in the dense oracle (uniform
+        # softmax); exclude them from the loss so grads compare cleanly.
+        valid = (np.asarray(seg) != 0)[:, :, None, None]
+        return jnp.sum(jnp.where(valid, jnp.sin(out), 0.0))
+
+    gf = jax.jit(jax.grad(loss_zigzag, argnums=(0, 1, 2)))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
 def test_ulysses_unbound_axis_fallback(world):
     from fluxmpi_tpu.parallel import ulysses_attention
 
