@@ -157,6 +157,13 @@ class DistributedDataLoader:
       shuffle/seed: reshuffle shard indices each epoch with a per-epoch key.
       drop_last: drop the trailing incomplete batch (default True — a ragged
         final batch would retrigger XLA compilation).
+      prefetch: keep this many global batches ahead of the consumer with
+        their host→device transfers already initiated (device transfers are
+        async in JAX: ``jax.make_array_from_process_local_data`` returns
+        while the DMA is in flight). Depth 2 means the device never waits
+        on the input pipeline as long as host assembly keeps up — the
+        device-side completion of the C++ host-side prefetcher. 0 disables
+        (each batch transfers on demand).
     """
 
     def __init__(
@@ -169,6 +176,7 @@ class DistributedDataLoader:
         shuffle: bool = False,
         seed: int = 0,
         drop_last: bool = True,
+        prefetch: int = 2,
     ):
         self.data = data
         self.mesh = mesh
@@ -198,6 +206,9 @@ class DistributedDataLoader:
         self.shuffle = shuffle
         self.seed = seed
         self.drop_last = drop_last
+        if prefetch < 0:
+            raise ValueError(f"prefetch must be >= 0, got {prefetch}")
+        self.prefetch = prefetch
         self._epoch = 0
         # Per-process shard sizes can differ (ceil partition, remainder on
         # the last rank). jax.make_array_from_process_local_data is a
@@ -251,6 +262,26 @@ class DistributedDataLoader:
         return None
 
     def __iter__(self) -> Iterator[Any]:
+        it = self._iter_batches()
+        if not self.prefetch:
+            yield from it
+            return
+        # Device-side prefetch (flax prefetch_to_device shape, mesh-sharded):
+        # run the batch source ahead of the consumer so each global batch's
+        # host→device transfer is in flight while the device executes the
+        # current step. The queue holds `prefetch` batches beyond the one
+        # handed out.
+        from collections import deque
+
+        queue: deque = deque()
+        for batch in it:
+            queue.append(batch)
+            if len(queue) > self.prefetch:
+                yield queue.popleft()
+        while queue:
+            yield queue.popleft()
+
+    def _iter_batches(self) -> Iterator[Any]:
         n = len(self.data)
         order = np.arange(n)
         if self.shuffle:

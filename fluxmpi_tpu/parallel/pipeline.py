@@ -31,6 +31,22 @@ forwards during the backward sweep so at most one tick's activations are
 live — the 1F1B working-set bound, paid in FLOPs instead of schedule
 complexity (the right trade on MXU-rich TPUs).
 
+**Interleaved (virtual-stage) schedule** (``interleave=v > 1``): each
+device owns ``v`` non-adjacent chunks of the layer stack — chunk ``c``
+lives on device ``c mod S`` — and the schedule runs ``v`` back-to-back
+sweeps of the microbatch grid with period ``P = max(M_pad, 3S-3)``: chunk
+``q`` of microbatch ``m`` executes on its device at tick ``q·P + m + d``.
+Sweeps overlap (device 0 starts sweep ``q+1`` while the tail devices
+finish sweep ``q``), cutting the fill/drain bubble by ``v``:
+``O(S)/(v·M + O(S))`` instead of ``O(S)/(M + O(S))`` — the
+Megatron-interleaved economics in SPMD form. Between sweeps, finished
+chunk-``q`` outputs ride the normal output conveyor to their owner device
+and are re-injected on the normal feed ring just-in-time for chunk
+``q+1``, so the staging stays pp-sharded (O(B/S) per device) and no new
+communication pattern is introduced; the ``3S-3`` floor on the period is
+exactly the conveyor+feed round-trip time. Total ticks:
+``(v-1)·P + M_pad + 2(S-1)`` (:func:`pipeline_tick_count`).
+
 Memory footprint: both the input stream and the outputs are **sharded over
 the pp axis** — device d holds only its own ``M/S`` input microbatches,
 which travel to stage 0 just-in-time on a backward ppermute "feed" ring
@@ -54,7 +70,36 @@ from jax.sharding import Mesh, PartitionSpec as P
 from .. import config
 from ._compat import shard_map_unchecked
 
-__all__ = ["pipeline_apply", "make_pipeline_fn", "stack_stage_params", "pipeline_rules"]
+__all__ = [
+    "pipeline_apply",
+    "make_pipeline_fn",
+    "stack_stage_params",
+    "pipeline_rules",
+    "pipeline_tick_count",
+]
+
+
+def _schedule_period(m_pad: int, n_stages: int, interleave: int) -> int:
+    """Sweep period of the interleaved schedule. ``3S-3`` is the worst-case
+    conveyor-capture → feed-reinjection round trip (capture after
+    ``S-1 + (i+1) mod S`` post-finish hops, reinjection ``i`` ticks before
+    consumption), so a period of ``max(M_pad, 3S-3)`` guarantees every
+    chunk-``q`` output is back in its owner's accumulator before chunk
+    ``q+1`` needs it. Plain GPipe (v=1) has no re-feed and keeps P=M_pad."""
+    if interleave == 1:
+        return m_pad
+    return max(m_pad, 3 * n_stages - 3)
+
+
+def pipeline_tick_count(
+    n_microbatches: int, n_stages: int, interleave: int = 1
+) -> int:
+    """Ticks one :func:`pipeline_apply` scan runs for — the schedule-length
+    audit hook (each tick does one chunk-compute per device, so
+    useful-work fraction = ``v·M_pad / (S · ticks)``)."""
+    m_pad = -(-n_microbatches // n_stages) * n_stages
+    period = _schedule_period(m_pad, n_stages, interleave)
+    return (interleave - 1) * period + m_pad + 2 * (n_stages - 1)
 
 
 def _check_stacked_leaves(tree: Any, expected_dim: int, what: str) -> None:
@@ -74,12 +119,37 @@ def _check_stacked_leaves(tree: Any, expected_dim: int, what: str) -> None:
             )
 
 
-def stack_stage_params(stage_params_list: list[Any]) -> Any:
+def stack_stage_params(
+    stage_params_list: list[Any],
+    *,
+    n_stages: int | None = None,
+    interleave: int = 1,
+) -> Any:
     """Stack per-stage parameter pytrees into one tree whose leaves have a
-    leading ``n_stages`` dimension (shard it over the ``pp`` axis)."""
-    return jax.tree_util.tree_map(
-        lambda *leaves: jnp.stack(leaves), *stage_params_list
-    )
+    leading ``n_stages`` dimension (shard it over the ``pp`` axis).
+
+    For the interleaved schedule pass ``interleave=v`` and ``n_stages=S``
+    with the ``v·S`` chunks in natural layer order: they are stacked in
+    **round-robin device order** (device d's shard = chunks
+    ``d, S+d, …``), which is the canonical parameter layout
+    :func:`make_pipeline_fn` consumes — the reorder happens once here at
+    setup, never per step (gradients and optimizer state stay in the same
+    layout throughout training)."""
+    chunks = list(stage_params_list)
+    if interleave > 1:
+        if n_stages is None:
+            raise ValueError("interleave > 1 requires n_stages")
+        if len(chunks) != n_stages * interleave:
+            raise ValueError(
+                f"expected n_stages·interleave = {n_stages * interleave} "
+                f"chunks, got {len(chunks)}"
+            )
+        chunks = [
+            chunks[q * n_stages + d]
+            for d in range(n_stages)
+            for q in range(interleave)
+        ]
+    return jax.tree_util.tree_map(lambda *leaves: jnp.stack(leaves), *chunks)
 
 
 def pipeline_rules(pp_axis: str | None = None):
@@ -104,23 +174,33 @@ def pipeline_apply(
     axis_name: str | None = None,
     remat_stages: bool = False,
     input_sharded: bool = False,
+    interleave: int = 1,
 ):
     """Run the stage-partitioned network over the bound ``pp`` axis.
 
     Call INSIDE ``shard_map`` (or use :func:`make_pipeline_fn` for the jitted
-    wrapper). ``stacked_params`` leaves arrive stage-local (leading dim 1 —
-    the shard of the stacked tree). ``x`` is either the full batch
-    ``[B, ...]`` (``input_sharded=False``; ``B`` divisible by
-    ``n_microbatches``) or — the memory-proper layout — this device's own
-    microbatch block ``[M_pad/S · mb, ...]`` (``input_sharded=True``, the
-    layout :func:`make_pipeline_fn` uses; the sequence-padded grid must then
-    be materialized by the caller, ``M_pad = ceil(M/S)·S``).
+    wrapper). ``stacked_params`` leaves arrive stage-local (leading dim =
+    ``interleave`` — this device's chunks of the round-robin-sharded stack).
+    ``x`` is either the full batch ``[B, ...]`` (``input_sharded=False``;
+    ``B`` divisible by ``n_microbatches``) or — the memory-proper layout —
+    this device's own microbatch block ``[M_pad/S · mb, ...]``
+    (``input_sharded=True``, the layout :func:`make_pipeline_fn` uses; the
+    sequence-padded grid must then be materialized by the caller,
+    ``M_pad = ceil(M/S)·S``).
 
     With sharded input, microbatches ride a *backward* ppermute feed ring to
     stage 0 just-in-time: device i forwards (or injects, when it owns it)
     global microbatch ``t + i`` at tick ``t``, which arrives at stage 0
     after exactly ``i`` hops at tick ``t + i`` — its consumption tick. One
     register per device, O(B/S) input memory.
+
+    ``interleave=v > 1`` (requires ``input_sharded``) runs the interleaved
+    virtual-stage schedule (module docstring): device d computes chunk
+    ``q·S + d`` of microbatch ``m`` at tick ``q·P + m + d``; sweep q's
+    captured outputs are re-injected on the same feed ring as sweep q+1's
+    inputs. The per-tick chunk index is selected with ``lax.switch`` over
+    the v resident chunks (static param slices — no per-tick HBM gather of
+    weights).
 
     Returns the **pp-sharded** local output block ``[M_pad/S · mb, ...]``:
     device ``d`` holds microbatches ``[d·M_pad/S, (d+1)·M_pad/S)``. The
@@ -132,18 +212,34 @@ def pipeline_apply(
     axis_name = axis_name or config.PP_AXIS_NAME
     n_stages = jax.lax.axis_size(axis_name)
     stage_idx = jax.lax.axis_index(axis_name)
+    v = int(interleave)
+    if v < 1:
+        raise ValueError(f"interleave must be >= 1, got {interleave}")
+    if v > 1 and not input_sharded:
+        raise ValueError(
+            "interleave > 1 requires input_sharded=True (sweep outputs are "
+            "re-fed from the pp-sharded accumulator)"
+        )
     _check_stacked_leaves(
-        stacked_params, 1, f"local leading dim (the '{axis_name}'-axis shard)"
+        stacked_params, v,
+        f"local leading dim (the '{axis_name}'-axis shard of "
+        f"{v}·n_stages chunks)",
     )
-    params_local = jax.tree_util.tree_map(lambda p: p[0], stacked_params)
     if remat_stages:
         stage_fn = jax.checkpoint(stage_fn)
+
+    def chunk_fn(q_static):
+        params_q = jax.tree_util.tree_map(
+            lambda p: p[q_static], stacked_params
+        )
+        return lambda inp: stage_fn(params_q, inp)
 
     # Pad the microbatch grid to a multiple of S so every device owns an
     # equal output block (padding microbatches compute on stale/zero input
     # and are never captured; the wrapper trims them).
     m_pad = -(-n_microbatches // n_stages) * n_stages
     per_dev = m_pad // n_stages
+    period = _schedule_period(m_pad, n_stages, v)
 
     if input_sharded:
         if x.shape[0] % per_dev:
@@ -162,8 +258,7 @@ def pipeline_apply(
     x_mb = x.reshape(-1, mb, *x.shape[1:])
 
     out_aval = jax.eval_shape(
-        lambda p, a: stage_fn(p, a),
-        params_local,
+        chunk_fn(0),
         jax.ShapeDtypeStruct((mb, *x.shape[1:]), x.dtype),
     )
     if out_aval.shape != (mb, *x.shape[1:]) or out_aval.dtype != x.dtype:
@@ -173,11 +268,12 @@ def pipeline_apply(
             f"{(mb, *x.shape[1:])}/{x.dtype}"
         )
 
-    # Finished microbatch w leaves stage S-1 at tick w+S-1, then rides the
-    # wrap-around conveyor one hop per tick; its owner (device w // per_dev)
-    # captures it after (owner+1) mod S hops — strictly before the slot
-    # wraps, so one conveyor register per device suffices.
-    n_ticks = m_pad + 2 * (n_stages - 1)
+    # Finished microbatch w of the final sweep leaves stage S-1 at tick
+    # (v-1)·P + w + S-1, then rides the wrap-around conveyor one hop per
+    # tick; its owner (device w // per_dev) captures it after
+    # (owner+1) mod S hops — strictly before the slot wraps, so one
+    # conveyor register per device suffices.
+    n_ticks = (v - 1) * period + m_pad + 2 * (n_stages - 1)
     fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
     ring_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
     back_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
@@ -186,15 +282,29 @@ def pipeline_apply(
     def tick(carry, t):
         act, conv, feed, acc = carry
         if input_sharded:
-            # Feed ring: device i's outgoing value this tick is global
-            # microbatch g = t + i — from its own shard when it owns g,
-            # else whatever arrived (an in-transit item from a higher
-            # owner; the chain is conflict-free because injection ticks
-            # g - owner are unique per microbatch).
+            # Feed ring: device i's outgoing value this tick is (sweep qf,
+            # microbatch mf) with qf·P + mf = t + i — from its own storage
+            # when it owns mf (sweep 0: the input shard; sweep ≥ 1: the
+            # captured previous-sweep output in acc), else whatever arrived
+            # (an in-transit item from a higher owner; the chain is
+            # conflict-free because injection ticks are unique per item).
             g = t + stage_idx
-            own = g // per_dev == stage_idx
-            local_g = jnp.clip(g - stage_idx * per_dev, 0, per_dev - 1)
-            outgoing = jnp.where(own, x_mb[local_g], feed)
+            qf = g // period
+            mf = g % period
+            own = jnp.logical_and(mf // per_dev == stage_idx, qf < v)
+            local_g = jnp.clip(mf - stage_idx * per_dev, 0, per_dev - 1)
+            x_src = jax.lax.dynamic_index_in_dim(
+                x_mb, jnp.minimum(local_g, x_mb.shape[0] - 1), 0,
+                keepdims=False,
+            )
+            if v > 1:
+                acc_src = jax.lax.dynamic_index_in_dim(
+                    acc, local_g, 0, keepdims=False
+                )
+                src = jnp.where(qf == 0, x_src, acc_src)
+            else:
+                src = x_src
+            outgoing = jnp.where(own, src, feed)
             # Stage 0's outgoing value IS its tick-t input (g = t).
             inp = jnp.where(stage_idx == 0, outgoing, act)
             feed_next = jax.lax.ppermute(outgoing, axis_name, back_perm)
@@ -206,20 +316,32 @@ def pipeline_apply(
                 stage_idx == 0, x_mb[jnp.minimum(t, n_microbatches - 1)], act
             )
             feed_next = feed
-        out = stage_fn(params_local, inp)
+        # This tick's resident chunk: sweep q = (t - d) // P (clamped;
+        # out-of-range ticks compute garbage that is never captured).
+        if v > 1:
+            q = jnp.clip((t - stage_idx) // period, 0, v - 1)
+            out = jax.lax.switch(
+                q, [chunk_fn(qi) for qi in range(v)], inp
+            )
+        else:
+            out = chunk_fn(0)(inp)
 
         # Capture: the item arriving on this device's conveyor register this
-        # tick is microbatch t - (S-1) - hops (the last stage captures its
-        # own finished output directly, hops == 0).
+        # tick finished sweep qc at tick qc·P + wc + (S-1), then rode
+        # `hops` conveyor hops (the last stage captures its own finished
+        # output directly, hops == 0). Sweep windows never overlap on the
+        # conveyor (P ≥ M_pad), so (qc, wc) is unique per tick.
         item = jnp.where(stage_idx == n_stages - 1, out, conv)
-        widx = t - (n_stages - 1) - hops
+        tc = t - (n_stages - 1) - hops
+        qc = tc // period
+        wc = tc - qc * period
         mine = jnp.logical_and(
-            widx >= 0,
+            jnp.logical_and(tc >= 0, qc < v),
             jnp.logical_and(
-                widx < n_microbatches, widx // per_dev == stage_idx
+                wc < n_microbatches, wc // per_dev == stage_idx
             ),
         )
-        local_idx = jnp.clip(widx - stage_idx * per_dev, 0, per_dev - 1)
+        local_idx = jnp.clip(wc - stage_idx * per_dev, 0, per_dev - 1)
         acc = jnp.where(
             mine,
             jax.lax.dynamic_update_index_in_dim(acc, item, local_idx, 0),
@@ -250,6 +372,7 @@ def make_pipeline_fn(
     n_microbatches: int,
     axis_name: str | None = None,
     remat_stages: bool = False,
+    interleave: int = 1,
 ):
     """Jitted eager wrapper: ``fn(stacked_params, x) -> y`` with the stacked
     stage dimension laid over ``axis_name`` and the batch **sharded along
@@ -258,11 +381,20 @@ def make_pipeline_fn(
     The output batch dimension likewise comes back sharded over the pp axis
     (see :func:`pipeline_apply`); downstream jit ops consume it
     transparently. Differentiable — compose with ``jax.value_and_grad`` for
-    training."""
+    training.
+
+    ``interleave=v > 1`` selects the interleaved virtual-stage schedule:
+    ``stacked_params`` then carries ``v·n_stages`` chunks in the
+    **round-robin device order** produced by
+    ``stack_stage_params(chunks, n_stages=S, interleave=v)`` (device d
+    owns chunks ``d, S+d, 2S+d, …``). The reorder happens once at stacking
+    time — a per-step permute here would reshuffle every parameter across
+    the pp axis on each forward/backward."""
     from ..runtime import global_mesh
 
     mesh = mesh or global_mesh()
     axis_name = axis_name or config.PP_AXIS_NAME
+    v = int(interleave)
 
     def body(stacked_params, x):
         return pipeline_apply(
@@ -273,6 +405,7 @@ def make_pipeline_fn(
             axis_name=axis_name,
             remat_stages=remat_stages,
             input_sharded=True,
+            interleave=v,
         )
 
     param_specs = P(axis_name)  # leading stage dim; rest replicated
@@ -281,10 +414,14 @@ def make_pipeline_fn(
     )
     n_stages = mesh.shape[axis_name]
     m_pad = -(-n_microbatches // n_stages) * n_stages
+    n_chunks = v * n_stages
 
     @jax.jit
     def fn(stacked_params, x):
-        _check_stacked_leaves(stacked_params, n_stages, "leading dim == n_stages")
+        _check_stacked_leaves(
+            stacked_params, n_chunks,
+            f"leading dim == {'interleave·' if v > 1 else ''}n_stages"
+        )
         if x.shape[0] % n_microbatches:
             raise ValueError(
                 f"batch {x.shape[0]} not divisible by n_microbatches "

@@ -181,3 +181,72 @@ def test_array_dataset_validation(world):
         fm.ArrayDataset({"a": np.ones((3,)), "b": np.ones((4,))})
     with pytest.raises(ValueError):
         fm.ArrayDataset({})
+
+
+def test_prefetch_queue_stays_ahead(world):
+    # VERDICT r3 next #2: the device-side prefetch stage must run the batch
+    # source AHEAD of the consumer, so each global batch's host→device
+    # transfer is in flight while the previous step executes.
+    import fluxmpi_tpu as fm
+
+    xs = np.arange(64, dtype=np.float32).reshape(64, 1)
+    loader = fm.DistributedDataLoader(fm.ArrayDataset((xs,)), 8, prefetch=2)
+    pulled = []
+    orig = loader._iter_batches
+
+    def spy():
+        for i, b in enumerate(orig()):
+            pulled.append(i)
+            yield b
+
+    loader._iter_batches = spy
+    it = iter(loader)
+    next(it)
+    # Consumer holds batch 0; the source has already produced (= initiated
+    # transfer of) the next `prefetch` batches.
+    assert len(pulled) == 3
+    next(it)
+    assert len(pulled) == 4
+    # Full drain still yields every batch exactly once.
+    rest = list(it)
+    assert len(rest) == 8 - 2
+    assert pulled == list(range(8))
+
+
+def test_prefetch_zero_is_on_demand(world):
+    import fluxmpi_tpu as fm
+
+    xs = np.arange(32, dtype=np.float32).reshape(32, 1)
+    loader = fm.DistributedDataLoader(fm.ArrayDataset((xs,)), 8, prefetch=0)
+    pulled = []
+    orig = loader._iter_batches
+
+    def spy():
+        for i, b in enumerate(orig()):
+            pulled.append(i)
+            yield b
+
+    loader._iter_batches = spy
+    it = iter(loader)
+    next(it)
+    assert len(pulled) == 1
+    assert len(list(it)) == 3
+
+    with pytest.raises(ValueError, match="prefetch"):
+        fm.DistributedDataLoader(fm.ArrayDataset((xs,)), 8, prefetch=-1)
+
+
+def test_prefetch_matches_unprefetched(world):
+    # Same batches, same order, same values — prefetch only changes timing.
+    import fluxmpi_tpu as fm
+
+    rng = np.random.default_rng(11)
+    xs = rng.normal(size=(48, 3)).astype(np.float32)
+    a = fm.DistributedDataLoader(
+        fm.ArrayDataset((xs,)), 8, shuffle=True, seed=5, prefetch=2
+    )
+    b = fm.DistributedDataLoader(
+        fm.ArrayDataset((xs,)), 8, shuffle=True, seed=5, prefetch=0
+    )
+    for ba, bb in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(ba[0]), np.asarray(bb[0]))

@@ -370,24 +370,140 @@ def test_flash_fn_packed_plus_padding_mask(world):
     )
 
 
-def test_flash_fn_poisons_unrepresentable_mask(world):
-    # Code-review r3 follow-up: a mask that segment ids cannot represent
-    # (e.g. a causal mask passed with causal=False) must NaN-poison the
-    # output — loud failure, never silently-wrong attention.
+def test_flash_fn_rejects_unrepresentable_concrete_mask(world):
+    # VERDICT r3 next #10: an unrepresentable CONCRETE mask (e.g. a causal
+    # mask passed with causal=False) must be a Python ValueError at call
+    # time — not a mid-training NaN.
+    import pytest
     import flax.linen as nn
 
     from fluxmpi_tpu.ops import flash_attention_fn
 
     q, k, v = _qkv(seed=18)
     causal_mask = nn.make_causal_mask(jnp.zeros((2, 64)))
-    out = flash_attention_fn(block_q=16, block_k=16)(q, k, v, mask=causal_mask)
-    assert np.all(np.isnan(np.asarray(out, dtype=np.float32)))
+    with pytest.raises(ValueError, match="not representable"):
+        flash_attention_fn(block_q=16, block_k=16)(q, k, v, mask=causal_mask)
 
-    # …and a representable mask on the same path stays NaN-free.
+    # …and a representable mask on the same path works.
     valid = jnp.asarray(np.ones((2, 64), bool))
     pad_mask = nn.make_attention_mask(valid, valid)
     out = flash_attention_fn(block_q=16, block_k=16)(q, k, v, mask=pad_mask)
     assert not np.any(np.isnan(np.asarray(out, dtype=np.float32)))
+
+
+def test_flash_fn_poisons_unrepresentable_traced_mask(world):
+    # Genuinely dynamic (traced) masks can only be checked on-device: the
+    # NaN-poison remains the last resort there — loud failure, never
+    # silently-wrong attention.
+    import flax.linen as nn
+
+    from fluxmpi_tpu.ops import flash_attention_fn
+
+    q, k, v = _qkv(seed=18)
+    causal_mask = nn.make_causal_mask(jnp.zeros((2, 64)))
+
+    @jax.jit
+    def run(q, k, v, mask):
+        return flash_attention_fn(block_q=16, block_k=16)(q, k, v, mask=mask)
+
+    out = run(q, k, v, causal_mask)
+    assert np.all(np.isnan(np.asarray(out, dtype=np.float32)))
+
+    # mask_check=False skips the runtime check (validated-pipeline mode):
+    # same call, no poison — the mask degrades to its segment projection.
+    @jax.jit
+    def run_unchecked(q, k, v, mask):
+        return flash_attention_fn(block_q=16, block_k=16, mask_check=False)(
+            q, k, v, mask=mask
+        )
+
+    out = run_unchecked(q, k, v, causal_mask)
+    assert not np.any(np.isnan(np.asarray(out, dtype=np.float32)))
+
+
+def test_flash_fn_head_varying_mask_rejected(world):
+    # Per-head masks are unrepresentable by per-batch segment ids; the
+    # any-over-heads reduction used to let them through silently.
+    import pytest
+
+    from fluxmpi_tpu.ops import flash_attention_fn
+
+    q, k, v = _qkv(seed=19)
+    m = np.ones((2, 4, 64, 64), bool)
+    m[:, 0] = False  # head 0 attends nothing; other heads attend all
+    with pytest.raises(ValueError, match="not representable"):
+        flash_attention_fn(block_q=16, block_k=16)(q, k, v, mask=jnp.asarray(m))
+
+
+def test_flash_fn_dropout_dense_fallback(world):
+    # VERDICT r3 next #9: dropout_rate > 0 in training mode transparently
+    # takes the dense fallback with flax-exact semantics — no user-visible
+    # branching, and it matches flax's own dot_product_attention under the
+    # same rng.
+    import flax.linen as nn
+
+    from fluxmpi_tpu.ops import flash_attention_fn
+
+    q, k, v = _qkv(seed=20)
+    rng = jax.random.PRNGKey(7)
+    out = flash_attention_fn(causal=True)(
+        q, k, v,
+        dropout_rng=rng, dropout_rate=0.3, deterministic=False,
+        broadcast_dropout=True,
+    )
+    mask = nn.make_causal_mask(jnp.zeros((2, 64)))
+    expected = nn.dot_product_attention(
+        q, k, v, mask=mask,
+        dropout_rng=rng, dropout_rate=0.3, deterministic=False,
+        broadcast_dropout=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expected), atol=2e-5
+    )
+    # deterministic=True ignores dropout and stays on the flash path.
+    out_det = flash_attention_fn(causal=True)(
+        q, k, v, dropout_rate=0.3, deterministic=True
+    )
+    no_drop = flash_attention_fn(causal=True)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out_det), np.asarray(no_drop))
+
+
+def test_flash_fn_dropout_module_trains(world):
+    # A flax attention module with dropout trains through the adapter end
+    # to end (grads finite), with no user-visible branching.
+    import flax.linen as nn
+    import optax
+
+    from fluxmpi_tpu.ops import flash_attention_fn
+
+    attn = nn.MultiHeadDotProductAttention(
+        num_heads=4, qkv_features=32, dropout_rate=0.2,
+        attention_fn=flash_attention_fn(causal=True),
+    )
+    x = jnp.asarray(
+        np.random.default_rng(21).normal(size=(2, 16, 32)).astype(np.float32)
+    )
+    params = attn.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        x, x, deterministic=False,
+    )
+
+    def loss_fn(p, rng):
+        y = attn.apply(
+            p, x, x, deterministic=False, rngs={"dropout": rng}
+        )
+        return jnp.mean(y**2)
+
+    g = jax.jit(jax.grad(loss_fn))(params, jax.random.PRNGKey(2))
+    assert all(
+        np.all(np.isfinite(np.asarray(leaf)))
+        for leaf in jax.tree_util.tree_leaves(g)
+    )
+    # and an optimizer step applies cleanly
+    opt = optax.adam(1e-3)
+    state = opt.init(params)
+    updates, _ = opt.update(g, state, params)
+    optax.apply_updates(params, updates)
 
 
 def _dense_window(q, k, v, window):

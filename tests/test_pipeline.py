@@ -275,3 +275,111 @@ def test_pipeline_input_sharded_over_pp(world):
     gs = jax.grad(lambda xx: jnp.sum(jnp.sin(serial(params_list, xx))))(x)
     np.testing.assert_allclose(np.asarray(gp), np.asarray(gs),
                                rtol=2e-5, atol=2e-6)
+
+
+# ---- interleaved (virtual-stage) schedule (VERDICT r3 next #6) ----
+
+
+def test_interleaved_forward_matches_sequential(world):
+    # v=2 chunks per device: 8 virtual stages on 4 devices, natural layer
+    # order in, round-robin placement inside.
+    from fluxmpi_tpu.parallel.pipeline import make_pipeline_fn, stack_stage_params
+
+    n_stages, v, d = 4, 2, 8
+    mesh = _mesh_pp(n_stages)
+    stages = _stages(n_stages * v, d, seed=40)
+    stacked = stack_stage_params(stages, n_stages=n_stages, interleave=v)
+    x = jnp.asarray(
+        np.random.default_rng(41).normal(size=(16, d)).astype(np.float32)
+    )
+    fn = make_pipeline_fn(_stage_fn, mesh, n_microbatches=8, interleave=v)
+    y = fn(stacked, x)
+    ref = _sequential(stages, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("m", [1, 2, 4, 8, 16])
+def test_interleaved_microbatch_counts(world, m):
+    # Small microbatch counts force the 3S-3 period floor (the conveyor
+    # round-trip); every count must still be exact.
+    from fluxmpi_tpu.parallel.pipeline import make_pipeline_fn, stack_stage_params
+
+    n_stages, v, d = 4, 3, 4
+    mesh = _mesh_pp(n_stages)
+    stages = _stages(n_stages * v, d, seed=42)
+    stacked = stack_stage_params(stages, n_stages=n_stages, interleave=v)
+    x = jnp.asarray(
+        np.random.default_rng(43).normal(size=(16, d)).astype(np.float32)
+    )
+    ref = _sequential(stages, x)
+    y = make_pipeline_fn(_stage_fn, mesh, n_microbatches=m, interleave=v)(
+        stacked, x
+    )
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-5, atol=1e-6)
+
+
+def test_interleaved_grads_match_sequential(world):
+    from fluxmpi_tpu.parallel.pipeline import make_pipeline_fn, stack_stage_params
+
+    n_stages, v, d = 2, 2, 4
+    mesh = _mesh_pp(n_stages)
+    stages = _stages(n_stages * v, d, seed=44)
+    stacked = stack_stage_params(stages, n_stages=n_stages, interleave=v)
+    x = jnp.asarray(
+        np.random.default_rng(45).normal(size=(8, d)).astype(np.float32)
+    )
+    fn = make_pipeline_fn(_stage_fn, mesh, n_microbatches=4, interleave=v)
+
+    def loss_pp(params, x):
+        return jnp.sum(jnp.sin(fn(params, x)))
+
+    def loss_seq(stage_list, x):
+        y = _sequential(stage_list, x)
+        return jnp.sum(jnp.sin(y))
+
+    gp = jax.grad(loss_pp)(stacked, x)
+    # Gradient of the sequential oracle per chunk, restacked into the same
+    # round-robin layout the pipeline uses.
+    gs = stack_stage_params(
+        jax.grad(loss_seq)(stages, x), n_stages=n_stages, interleave=v
+    )
+    for a, b in zip(jax.tree_util.tree_leaves(gp), jax.tree_util.tree_leaves(gs)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        )
+
+
+def test_interleaved_cuts_bubble(world):
+    # The whole point: for the same device count and model depth, v chunks
+    # per device shrink the schedule relative to v sequential GPipe sweeps,
+    # and the useful-work fraction strictly improves over running the same
+    # depth as v-fold-bigger GPipe stages.
+    from fluxmpi_tpu.parallel.pipeline import pipeline_tick_count
+
+    S, M = 4, 8
+    for v in (2, 4):
+        inter = pipeline_tick_count(M, S, interleave=v)
+        gpipe = pipeline_tick_count(M, S, interleave=1)
+        # v sequential sweeps would cost v·gpipe ticks; overlap wins.
+        assert inter < v * gpipe
+        # Utilization: interleaved does v·M unit-chunk computations in
+        # `inter` ticks; plain GPipe covers the same depth with v-unit
+        # stages: M·v units of work in gpipe·v tick-units.
+        util_inter = (v * M) / (S * inter) * S  # fraction of busy ticks
+        util_gpipe = (M) / gpipe
+        assert util_inter > util_gpipe
+    # v=1 reduces to the documented GPipe length M_pad + 2(S-1).
+    assert pipeline_tick_count(8, 4, 1) == 8 + 2 * 3
+
+
+def test_interleaved_rejects_bad_args(world):
+    from fluxmpi_tpu.parallel.pipeline import make_pipeline_fn, stack_stage_params
+
+    n_stages, d = 2, 4
+    mesh = _mesh_pp(n_stages)
+    stages = _stages(n_stages, d, seed=46)  # only S chunks for v=2
+    stacked = stack_stage_params(stages)
+    x = jnp.ones((8, d), jnp.float32)
+    fn = make_pipeline_fn(_stage_fn, mesh, n_microbatches=4, interleave=2)
+    with pytest.raises(ValueError, match="leading dim"):
+        fn(stacked, x)
