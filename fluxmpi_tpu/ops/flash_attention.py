@@ -1101,7 +1101,7 @@ def flash_attention_fn(
                 raise ValueError(
                     "dropout_rate > 0 with deterministic=False requires a "
                     "dropout_rng (flax passes it when the module is given "
-                    "an 'dropout' rng collection)"
+                    "a 'dropout' rng collection)"
                 )
             return _dense_dropout_attention(
                 query, key, value, mask, causal, window, dropout_rng,

@@ -95,8 +95,8 @@ def pipeline_tick_count(
     n_microbatches: int, n_stages: int, interleave: int = 1
 ) -> int:
     """Ticks one :func:`pipeline_apply` scan runs for — the schedule-length
-    audit hook (each tick does one chunk-compute per device, so
-    useful-work fraction = ``v·M_pad / (S · ticks)``)."""
+    audit hook (each device does one chunk-compute per tick, ``v·M_pad``
+    of them useful, so per-device utilization = ``v·M_pad / ticks``)."""
     m_pad = -(-n_microbatches // n_stages) * n_stages
     period = _schedule_period(m_pad, n_stages, interleave)
     return (interleave - 1) * period + m_pad + 2 * (n_stages - 1)

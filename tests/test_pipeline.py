@@ -365,7 +365,7 @@ def test_interleaved_cuts_bubble(world):
         # Utilization: interleaved does v·M unit-chunk computations in
         # `inter` ticks; plain GPipe covers the same depth with v-unit
         # stages: M·v units of work in gpipe·v tick-units.
-        util_inter = (v * M) / (S * inter) * S  # fraction of busy ticks
+        util_inter = (v * M) / inter  # per-device busy-tick fraction
         util_gpipe = (M) / gpipe
         assert util_inter > util_gpipe
     # v=1 reduces to the documented GPipe length M_pad + 2(S-1).
