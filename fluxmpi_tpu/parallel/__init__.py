@@ -11,6 +11,7 @@ from .pipeline import (  # noqa: F401
     make_pipeline_fn,
     pipeline_apply,
     pipeline_rules,
+    pipeline_tick_count,
     stack_stage_params,
 )
 from .ring import (  # noqa: F401
