@@ -5,6 +5,10 @@
 3. :class:`ResNet50` — the headline ImageNet DP workload.
 4. :class:`DEQ` — deep equilibrium model with implicit-gradient custom VJP.
 5. :class:`TransformerEncoder` — the wrapped-model adapter path.
+
+Beyond the five parity configs: ResNet-18/34/101, :class:`TransformerLM`,
+Switch-MoE variants, and :class:`ViT` (patch-conv + the same encoder
+stack; composes with the flash/ring/Ulysses ``attention_fn`` hooks).
 """
 
 from .mlp import MLP  # noqa: F401
@@ -25,3 +29,4 @@ from .resnet import (  # noqa: F401
 )
 from .deq import DEQ, fixed_point_solve  # noqa: F401
 from .transformer import TransformerEncoder, TransformerLM  # noqa: F401
+from .vit import ViT  # noqa: F401

@@ -1,0 +1,98 @@
+"""Vision Transformer — patch-embedded images through the encoder stack.
+
+Zoo extension beyond the reference's five benchmark configs (the reference
+is model-agnostic — any Optimisers.jl-compatible model trains under its DP
+layer, reference: docs/src/index.md:30-36 — so the zoo's breadth is this
+framework's to choose). Built TPU-first on the in-repo
+:class:`~fluxmpi_tpu.models.transformer.TransformerEncoder`:
+
+- patchify is ONE strided conv (``patch×patch`` kernel, stride = patch) —
+  an MXU-tiled matmul over ``patch²·C → d_model``, not a gather;
+- bf16-friendly dtype threading end to end, f32 head (the repo-wide
+  numerically-stable-softmax convention, models/resnet.py);
+- learned position embeddings + prepended CLS token, static shapes
+  throughout;
+- composes with every parallel layer like the other transformers: DP via
+  ``make_train_step``, TP via ``transformer_tp_rules`` (the encoder blocks
+  share that layout), sequence parallelism via ``attention_fn=``
+  (ring/Ulysses/flash drop-ins).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .transformer import TransformerEncoder
+
+__all__ = ["ViT"]
+
+
+class ViT(nn.Module):
+    """ViT classifier over NHWC images.
+
+    Defaults are ViT-S/16-ish at 224² (patch 16 → 196 tokens + CLS).
+    """
+
+    num_classes: int = 1000
+    patch: int = 16
+    num_layers: int = 12
+    d_model: int = 384
+    num_heads: int = 6
+    d_ff: int = 1536
+    dropout: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+    attention_fn: Callable | None = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = True) -> jnp.ndarray:
+        b, h, w, _ = x.shape
+        if h % self.patch or w % self.patch:
+            raise ValueError(
+                f"patch size {self.patch} must divide the image size {(h, w)}"
+            )
+        x = x.astype(self.dtype)
+        # Patchify = strided conv = one big matmul on the MXU.
+        x = nn.Conv(
+            self.d_model,
+            (self.patch, self.patch),
+            strides=(self.patch, self.patch),
+            padding="VALID",
+            dtype=self.dtype,
+            name="patch_embed",
+        )(x)
+        x = x.reshape(b, -1, self.d_model)  # [b, tokens, d]
+
+        cls = self.param(
+            "cls", nn.initializers.zeros_init(), (1, 1, self.d_model)
+        )
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (b, 1, self.d_model)).astype(self.dtype), x],
+            axis=1,
+        )
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, x.shape[1], self.d_model),
+        )
+        x = x + pos.astype(self.dtype)
+        if self.dropout:
+            x = nn.Dropout(self.dropout, deterministic=not train)(x)
+
+        x = TransformerEncoder(
+            num_layers=self.num_layers,
+            d_model=self.d_model,
+            num_heads=self.num_heads,
+            d_ff=self.d_ff,
+            dropout=self.dropout,
+            dtype=self.dtype,
+            attention_fn=self.attention_fn,
+            name="encoder",
+        )(x, train=train)
+
+        # CLS-token head in f32 (stable softmax/CE), repo convention.
+        return nn.Dense(
+            self.num_classes, dtype=jnp.float32, name="head"
+        )(x[:, 0])
