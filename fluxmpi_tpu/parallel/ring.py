@@ -227,17 +227,19 @@ def _ring_flash(
 
 def _local_attend(
     q, k, v, *, causal, segment_ids=None, use_flash=False,
-    block_q=None, block_k=None
+    block_q=None, block_k=None, window=None
 ):
     """Single-device attention with ring semantics — the n=1 ring. Used as
     the unbound-axis fallback so ring/zigzag models initialize and run
-    outside ``shard_map`` without a dense twin."""
+    outside ``shard_map`` without a dense twin, and as the local attend of
+    :func:`fluxmpi_tpu.parallel.ulysses.ulysses_attention` (where positions
+    are global, so the flash kernel's ``window`` applies directly)."""
     if use_flash:
         from ..ops.flash_attention import flash_attention
 
         return flash_attention(
             q, k, v, causal=causal, segment_ids=segment_ids,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, window=window,
         )
     qseg, kseg = _normalize_ring_segments(
         segment_ids, q.shape[0], q.shape[1], k.shape[1]
@@ -247,9 +249,12 @@ def _local_attend(
     mask = None
     if causal:
         sq, sk = q.shape[1], k.shape[1]
-        mask = (
-            jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
-        )[None, None]
+        pos = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        if window is not None:
+            pos = pos & (
+                jnp.arange(sq)[:, None] - jnp.arange(sk)[None, :] < window
+            )
+        mask = pos[None, None]
     if qseg is not None:
         smask = _seg_mask4(qseg, kseg)
         mask = smask if mask is None else jnp.logical_and(mask, smask)
@@ -285,6 +290,7 @@ def ring_attention(
     use_flash: bool = False,
     block_q: int | None = None,
     block_k: int | None = None,
+    window: int | None = None,
 ) -> jnp.ndarray:
     """Blockwise ring attention; call inside ``shard_map`` with the sequence
     dimension of q/k/v sharded over ``axis_name``.
@@ -305,7 +311,17 @@ def ring_attention(
     leaves VMEM); local sequence lengths must then divide ``block_q`` /
     ``block_k`` (both threaded to the kernel — tune for shards smaller
     than 128).
+
+    ``window`` (sliding-window / local attention, requires ``causal=True``)
+    is honored on the dense ring path via global-position masks. It is not
+    expressible through the flash kernel here — the kernel masks on
+    *local* block positions while ring blocks carry global offsets — so
+    ``use_flash=True`` with a window raises; use
+    :func:`fluxmpi_tpu.parallel.ulysses.ulysses_attention` (full sequence
+    local, kernel window applies directly) for flash-speed windowed SP.
     """
+    if window is not None and not causal:
+        raise ValueError("window (sliding-window attention) requires causal=True")
     name = axis_name or config.SP_AXIS_NAME
     try:
         n = jax.lax.axis_size(name)
@@ -318,12 +334,21 @@ def ring_attention(
         return _local_attend(
             q, k, v, causal=causal, segment_ids=segment_ids,
             use_flash=use_flash, block_q=block_q, block_k=block_k,
+            window=window,
         )
     idx = jax.lax.axis_index(name)
     b, sq, h, d = q.shape
     qseg, kseg = _normalize_ring_segments(segment_ids, b, sq, k.shape[1])
 
     if use_flash:
+        if window is not None:
+            raise ValueError(
+                "ring_attention(use_flash=True) cannot honor window: the "
+                "flash kernel masks local block positions, but ring blocks "
+                "carry global offsets. Use the dense ring "
+                "(use_flash=False) or ulysses_attention for windowed "
+                "sequence parallelism."
+            )
         return _ring_flash(
             q, k, v, name=name, causal=causal, n=n, idx=idx,
             qseg=qseg, kseg=kseg, block_q=block_q, block_k=block_k,
@@ -350,7 +375,12 @@ def ring_attention(
         if causal:
             q_pos = idx * sq + jnp.arange(sq)
             k_pos = src * k_blk.shape[1] + jnp.arange(k_blk.shape[1])
-            mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
+            pos = q_pos[:, None] >= k_pos[None, :]
+            if window is not None:
+                pos = jnp.logical_and(
+                    pos, q_pos[:, None] - k_pos[None, :] < window
+                )
+            mask = pos[None, None]
         if has_seg:
             smask = _seg_mask4(qseg, kseg_blk)
             mask = smask if mask is None else jnp.logical_and(mask, smask)
@@ -543,6 +573,7 @@ def ring_attention_fn(
     use_flash: bool = False,
     block_q: int | None = None,
     block_k: int | None = None,
+    window: int | None = None,
 ):
     """An ``attention_fn`` drop-in for ``nn.MultiHeadDotProductAttention``.
 
@@ -569,6 +600,7 @@ def ring_attention_fn(
         return ring_attention(
             query, key, value, axis_name=axis_name, causal=causal,
             use_flash=use_flash, block_q=block_q, block_k=block_k,
+            window=window,
         )
 
     return fn
@@ -584,6 +616,7 @@ def make_ring_attention(
     schedule: str = "contiguous",
     block_q: int | None = None,
     block_k: int | None = None,
+    window: int | None = None,
 ):
     """Wrap :func:`ring_attention` for eager use on mesh-sharded arrays.
 
@@ -602,6 +635,20 @@ def make_ring_attention(
         raise ValueError(f"unknown schedule {schedule!r}")
     if schedule == "zigzag" and not causal:
         raise ValueError("zigzag schedule only applies to causal attention")
+    if schedule == "zigzag" and window is not None:
+        raise ValueError(
+            "window is not supported on the zigzag schedule (chunk attends "
+            "carry global offsets); use schedule='contiguous' with "
+            "use_flash=False, or ulysses_attention"
+        )
+    if use_flash and window is not None:
+        # Same incompatibility ring_attention raises at trace time — catch
+        # it eagerly at construction, like the zigzag check above.
+        raise ValueError(
+            "ring_attention(use_flash=True) cannot honor window (the flash "
+            "kernel masks local block positions); use use_flash=False or "
+            "ulysses_attention for windowed sequence parallelism"
+        )
 
     mesh = mesh or global_mesh()
     sp = axis_name or config.SP_AXIS_NAME
@@ -620,7 +667,7 @@ def make_ring_attention(
             return ring_attention(
                 q, k, v, axis_name=sp, causal=causal, use_flash=use_flash,
                 segment_ids=seg if seg else None,
-                block_q=block_q, block_k=block_k,
+                block_q=block_q, block_k=block_k, window=window,
             )
 
     jitted_by_nseg: dict = {}

@@ -49,6 +49,7 @@ def ulysses_attention(
     use_flash: bool = False,
     block_q: int | None = None,
     block_k: int | None = None,
+    window: int | None = None,
 ) -> jnp.ndarray:
     """All-to-all sequence-parallel attention; call inside ``shard_map``
     with the sequence dimension (axis 1) of q/k/v sharded over
@@ -66,16 +67,25 @@ def ulysses_attention(
     preserves the exact GQA group structure and moves ``h_kv/h`` of the
     full-head K/V bytes.
 
+    ``window`` (sliding-window attention, requires ``causal=True``): the
+    local attend sees the FULL sequence, so global positions and the flash
+    kernel's O(seq·window) static tile skip both apply directly — this is
+    the layout to use for windowed long-context (the ring cannot express a
+    window through its flash path).
+
     Outside a bound axis (e.g. ``module.init``) this degrades to exact
     single-device attention, like the ring.
     """
     name = axis_name or config.SP_AXIS_NAME
+    if window is not None and not causal:
+        raise ValueError("window (sliding-window attention) requires causal=True")
     try:
         n = jax.lax.axis_size(name)
     except NameError:
         return _local_attend(
             q, k, v, causal=causal, segment_ids=segment_ids,
             use_flash=use_flash, block_q=block_q, block_k=block_k,
+            window=window,
         )
     b, s_local, h, d = q.shape
     h_kv = k.shape[2]
@@ -129,6 +139,7 @@ def ulysses_attention(
     out = _local_attend(
         qg, kg, vg, causal=causal, segment_ids=seg_full,
         use_flash=use_flash, block_q=block_q, block_k=block_k,
+        window=window,
     )
     return heads_to_seq(out)
 
@@ -139,6 +150,7 @@ def ulysses_attention_fn(
     use_flash: bool = False,
     block_q: int | None = None,
     block_k: int | None = None,
+    window: int | None = None,
 ):
     """``attention_fn`` drop-in for ``nn.MultiHeadDotProductAttention``
     modules applied inside a sequence-sharding ``shard_map`` (same usage
@@ -153,6 +165,7 @@ def ulysses_attention_fn(
         return ulysses_attention(
             query, key, value, axis_name=axis_name, causal=causal,
             use_flash=use_flash, block_q=block_q, block_k=block_k,
+            window=window,
         )
 
     return fn
@@ -167,6 +180,7 @@ def make_ulysses_attention(
     use_flash: bool = False,
     block_q: int | None = None,
     block_k: int | None = None,
+    window: int | None = None,
 ):
     """Eager wrapper over mesh-sharded arrays (mirror of
     :func:`fluxmpi_tpu.parallel.ring.make_ring_attention`)."""
@@ -180,7 +194,7 @@ def make_ulysses_attention(
     def body(q, k, v):
         return ulysses_attention(
             q, k, v, axis_name=sp, causal=causal, use_flash=use_flash,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, window=window,
         )
 
     mapped = shard_map_unchecked(

@@ -733,3 +733,70 @@ def test_flash_gqa_with_segments(world):
     gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gf, gd):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_flash_property_sweep(world, seed):
+    # Randomized config sweep: one dense-oracle comparison per seed across
+    # the kernel's whole feature cross-product (GQA ratio x causal x
+    # window x segments x block sizes x dtype) — breadth the individual
+    # feature tests don't cover pairwise.
+    from fluxmpi_tpu.ops import flash_attention
+
+    rng = np.random.default_rng(100 + seed)
+    b = int(rng.integers(1, 3))
+    sq = int(rng.choice([16, 32, 48]))
+    h_kv = int(rng.choice([1, 2]))
+    h = h_kv * int(rng.choice([1, 2, 4]))
+    d = int(rng.choice([8, 16]))
+    causal = bool(rng.integers(0, 2))
+    window = int(rng.choice([4, 8])) if causal and rng.integers(0, 2) else None
+    use_seg = bool(rng.integers(0, 2))
+    block = int(rng.choice([8, 16]))
+
+    q = jnp.asarray(rng.normal(size=(b, sq, h, d)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(b, sq, h_kv, d)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(b, sq, h_kv, d)).astype(np.float32))
+
+    seg = None
+    valid = np.ones((b, sq), bool)
+    if use_seg:
+        seg_np = np.ones((b, sq), np.int32)
+        for row in range(b):
+            cut = int(rng.integers(1, sq))
+            seg_np[row, cut:] = 2
+            if rng.integers(0, 2):
+                pad = int(rng.integers(1, sq // 4 + 1))
+                seg_np[row, -pad:] = 0
+        seg = jnp.asarray(seg_np)
+        valid = seg_np != 0
+
+    out = flash_attention(
+        q, k, v, causal=causal, window=window, segment_ids=seg,
+        block_q=block, block_k=block,
+    )
+
+    # Dense oracle with identical semantics.
+    kf = jnp.repeat(k, h // h_kv, axis=2)
+    vf = jnp.repeat(v, h // h_kv, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, kf) / np.sqrt(d)
+    mask = np.ones((b, 1, sq, sq), bool)
+    if causal:
+        pos = np.arange(sq)[:, None] >= np.arange(sq)[None, :]
+        if window is not None:
+            pos = pos & (np.arange(sq)[:, None] - np.arange(sq)[None, :] < window)
+        mask = mask & pos[None, None]
+    if seg is not None:
+        sm = (np.asarray(seg)[:, :, None] == np.asarray(seg)[:, None, :]) & (
+            np.asarray(seg)[:, None, :] != 0
+        )
+        mask = mask & sm[:, None]
+    s = jnp.where(jnp.asarray(mask), s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    expected = jnp.einsum("bhqk,bkhd->bqhd", p, vf)
+
+    np.testing.assert_allclose(
+        np.asarray(out)[valid], np.asarray(expected)[valid], atol=3e-5,
+        err_msg=f"config: b={b} sq={sq} h={h} h_kv={h_kv} causal={causal} "
+                f"window={window} seg={use_seg} block={block}",
+    )
