@@ -1,0 +1,103 @@
+"""End-to-end user journey: ViT image classification under DP.
+
+Everything a user switching from the reference needs in one script
+(reference quick-start shape: README.md:31-70 — init, sync, shard data,
+reduce gradients, train): mesh bring-up, rank-divergent init erased by
+``synchronize``, the C++-prefetched + device-prefetched data loader,
+ONE compiled train step, rank-aware logging, and checkpoint/resume via
+``CheckpointManager``.
+
+Run:  python examples/vit_classification.py [--simulate 8] [--epochs 4]
+"""
+
+import argparse
+import tempfile
+
+parser = argparse.ArgumentParser()
+parser.add_argument("--simulate", type=int, default=0)
+parser.add_argument("--epochs", type=int, default=4)
+parser.add_argument("--batch", type=int, default=32)
+args = parser.parse_args()
+
+if args.simulate:
+    import os
+
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={args.simulate}"
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+if args.simulate:
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import fluxmpi_tpu as fm
+from fluxmpi_tpu.models import ViT
+from fluxmpi_tpu.parallel import TrainState, make_train_step
+from fluxmpi_tpu.parallel.train import replicate
+from fluxmpi_tpu.utils import CheckpointManager
+
+mesh = fm.init(verbose=True)
+
+# Tiny synthetic "dataset": 4-class 32x32 images whose class is encoded in
+# the mean brightness of a quadrant (learnable quickly by a small ViT).
+rng = np.random.default_rng(0)
+N, CLASSES = 512, 4
+xs = rng.normal(scale=0.3, size=(N, 32, 32, 3)).astype(np.float32)
+ys = rng.integers(0, CLASSES, size=(N,)).astype(np.int32)
+for i in range(N):
+    q = ys[i]
+    xs[i, (q // 2) * 16 : (q // 2) * 16 + 16, (q % 2) * 16 : (q % 2) * 16 + 16] += 1.0
+
+model = ViT(num_classes=CLASSES, patch=8, num_layers=2, d_model=64,
+            num_heads=4, d_ff=128)
+
+# Rank-divergent init (each process sees a different key), then root wins.
+params = fm.synchronize(
+    model.init(jax.random.PRNGKey(fm.local_rank()), jnp.asarray(xs[:2]),
+               train=False)
+)
+
+loader = fm.DistributedDataLoader(
+    fm.DistributedDataContainer(fm.ArrayDataset((xs, ys))),
+    global_batch_size=args.batch,
+    shuffle=True,
+)  # C++ host assembly + depth-2 async device prefetch, both on by default
+
+optimizer = optax.adamw(1e-3)
+
+
+def loss_fn(p, mstate, batch):
+    bx, by = batch
+    logits = model.apply(p, bx, train=True)
+    return optax.softmax_cross_entropy_with_integer_labels(logits, by).mean(), mstate
+
+
+step = make_train_step(loss_fn, optimizer, mesh=mesh)
+state = replicate(TrainState.create(params, optimizer), mesh)
+
+ckpt_dir = tempfile.mkdtemp(prefix="fluxmpi_vit_")
+manager = CheckpointManager(ckpt_dir, max_to_keep=2)
+
+first = last = None
+for epoch in range(args.epochs):
+    for batch in loader:
+        state, loss = step(state, batch)
+    last = float(loss)
+    first = first if first is not None else last
+    fm.fluxmpi_println(f"epoch {epoch}: loss {last:.4f}")
+    manager.save(epoch, state)
+
+manager.wait_until_finished()
+assert manager.latest_step() == args.epochs - 1
+fm.fluxmpi_println(
+    f"loss {first:.4f} -> {last:.4f}; checkpoints in {ckpt_dir}"
+)
+assert last < first, "training did not reduce the loss"
+print("VIT_EXAMPLE_OK")

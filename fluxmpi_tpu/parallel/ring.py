@@ -367,24 +367,45 @@ def ring_attention(
         # After s rotations, the resident block originated on ring position
         # (idx - s) mod n.
         src = (idx - s) % n
-        # GQA: the rotating blocks keep their h_kv heads (small ICI hops);
-        # the repeat to h query heads happens locally, post-rotation.
-        kf = _expand_kv(k_blk, h).astype(jnp.float32)
-        vf = _expand_kv(v_blk, h).astype(jnp.float32)
-        mask = None
+        sk_blk = k_blk.shape[1]
+
+        def attend_block(carry):
+            o, m, l = carry
+            # GQA: the rotating blocks keep their h_kv heads (small ICI
+            # hops); the repeat to h query heads happens locally,
+            # post-rotation.
+            kf = _expand_kv(k_blk, h).astype(jnp.float32)
+            vf = _expand_kv(v_blk, h).astype(jnp.float32)
+            mask = None
+            if causal:
+                q_pos = idx * sq + jnp.arange(sq)
+                k_pos = src * sk_blk + jnp.arange(sk_blk)
+                pos = q_pos[:, None] >= k_pos[None, :]
+                if window is not None:
+                    pos = jnp.logical_and(
+                        pos, q_pos[:, None] - k_pos[None, :] < window
+                    )
+                mask = pos[None, None]
+            if has_seg:
+                smask = _seg_mask4(qseg, kseg_blk)
+                mask = smask if mask is None else jnp.logical_and(mask, smask)
+            return _block_attend(qf, kf, vf, o, m, l, mask)
+
         if causal:
-            q_pos = idx * sq + jnp.arange(sq)
-            k_pos = src * k_blk.shape[1] + jnp.arange(k_blk.shape[1])
-            pos = q_pos[:, None] >= k_pos[None, :]
+            # Skip ticks whose resident block is entirely masked — strictly
+            # in the future (the contiguous causal imbalance) or wholly
+            # outside the window band — the dense twin of _ring_flash's
+            # cond skip: the K/V still rotates, the compute doesn't run.
+            live = (idx + 1) * sq - 1 >= src * sk_blk
             if window is not None:
-                pos = jnp.logical_and(
-                    pos, q_pos[:, None] - k_pos[None, :] < window
+                live = jnp.logical_and(
+                    live, idx * sq - ((src + 1) * sk_blk - 1) < window
                 )
-            mask = pos[None, None]
-        if has_seg:
-            smask = _seg_mask4(qseg, kseg_blk)
-            mask = smask if mask is None else jnp.logical_and(mask, smask)
-        o2, m2, l2 = _block_attend(qf, kf, vf, o, m, l, mask)
+            o2, m2, l2 = jax.lax.cond(
+                live, attend_block, lambda c: c, (o, m, l)
+            )
+        else:
+            o2, m2, l2 = attend_block((o, m, l))
         k_next = jax.lax.ppermute(k_blk, name, perm)
         v_next = jax.lax.ppermute(v_blk, name, perm)
         kseg_next = (
@@ -590,6 +611,14 @@ def ring_attention_fn(
     ``sp`` axis the ring degrades to exact single-device attention (the
     n=1 ring), so parameters initialize without a dense twin.
     """
+    if use_flash and window is not None:
+        # Same eager rejection as make_ring_attention: otherwise init
+        # (unbound axis → flash kernel, window OK locally) would succeed
+        # and the first sharded apply would raise deep in the trace.
+        raise ValueError(
+            "ring_attention_fn(use_flash=True) cannot honor window on the "
+            "ring; use use_flash=False or ulysses_attention_fn"
+        )
 
     def fn(query, key, value, bias=None, mask=None, **kwargs):
         if bias is not None or mask is not None:

@@ -753,10 +753,12 @@ def test_flash_property_sweep(world, seed):
     window = int(rng.choice([4, 8])) if causal and rng.integers(0, 2) else None
     use_seg = bool(rng.integers(0, 2))
     block = int(rng.choice([8, 16]))
+    dtype = jnp.bfloat16 if rng.integers(0, 2) else jnp.float32
+    atol = 0.06 if dtype == jnp.bfloat16 else 3e-5
 
-    q = jnp.asarray(rng.normal(size=(b, sq, h, d)).astype(np.float32))
-    k = jnp.asarray(rng.normal(size=(b, sq, h_kv, d)).astype(np.float32))
-    v = jnp.asarray(rng.normal(size=(b, sq, h_kv, d)).astype(np.float32))
+    q = jnp.asarray(rng.normal(size=(b, sq, h, d)).astype(np.float32)).astype(dtype)
+    k = jnp.asarray(rng.normal(size=(b, sq, h_kv, d)).astype(np.float32)).astype(dtype)
+    v = jnp.asarray(rng.normal(size=(b, sq, h_kv, d)).astype(np.float32)).astype(dtype)
 
     seg = None
     valid = np.ones((b, sq), bool)
@@ -776,9 +778,10 @@ def test_flash_property_sweep(world, seed):
         block_q=block, block_k=block,
     )
 
-    # Dense oracle with identical semantics.
-    kf = jnp.repeat(k, h // h_kv, axis=2)
-    vf = jnp.repeat(v, h // h_kv, axis=2)
+    # Dense oracle with identical semantics (f32 math; bf16 inputs upcast).
+    kf = jnp.repeat(k, h // h_kv, axis=2).astype(jnp.float32)
+    vf = jnp.repeat(v, h // h_kv, axis=2).astype(jnp.float32)
+    q = q.astype(jnp.float32)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, kf) / np.sqrt(d)
     mask = np.ones((b, 1, sq, sq), bool)
     if causal:
@@ -796,7 +799,8 @@ def test_flash_property_sweep(world, seed):
     expected = jnp.einsum("bhqk,bkhd->bqhd", p, vf)
 
     np.testing.assert_allclose(
-        np.asarray(out)[valid], np.asarray(expected)[valid], atol=3e-5,
+        np.asarray(out, dtype=np.float32)[valid],
+        np.asarray(expected)[valid], atol=atol,
         err_msg=f"config: b={b} sq={sq} h={h} h_kv={h_kv} causal={causal} "
-                f"window={window} seg={use_seg} block={block}",
+                f"window={window} seg={use_seg} block={block} dtype={dtype}",
     )
