@@ -102,6 +102,68 @@ def _seg_mask(qseg_col, kseg_row):
     return (qseg_col == kseg_row) & (kseg_row != 0)
 
 
+def _hash_mix(h, k):
+    """One round of a murmur3-style 32-bit mix — uint32 adds/mults/xors/
+    shifts only, so it lowers identically in Pallas interpret mode, on the
+    TPU VPU, and in plain jnp (the reproducibility the dropout mask
+    needs)."""
+    k = (k * jnp.uint32(0xCC9E2D51)) & jnp.uint32(0xFFFFFFFF)
+    k = ((k << 15) | (k >> 17)) & jnp.uint32(0xFFFFFFFF)
+    k = (k * jnp.uint32(0x1B873593)) & jnp.uint32(0xFFFFFFFF)
+    h = h ^ k
+    h = ((h << 13) | (h >> 19)) & jnp.uint32(0xFFFFFFFF)
+    h = (h * jnp.uint32(5) + jnp.uint32(0xE6546B64)) & jnp.uint32(0xFFFFFFFF)
+    return h
+
+
+def _hash_final(h):
+    h = h ^ (h >> 16)
+    h = (h * jnp.uint32(0x85EBCA6B)) & jnp.uint32(0xFFFFFFFF)
+    h = h ^ (h >> 13)
+    h = (h * jnp.uint32(0xC2B2AE35)) & jnp.uint32(0xFFFFFFFF)
+    return h ^ (h >> 16)
+
+
+def _dropout_keep(seed, bh, q_pos, k_pos, keep_prob):
+    """Deterministic per-(batch·head, q, k) keep mask: a counter-based
+    murmur hash of the positions — NOT a stateful RNG — so the forward and
+    both backward kernels regenerate bit-identical masks from the same
+    (seed, bh) pair with no side state. ``q_pos``/``k_pos`` broadcast to
+    the tile shape; returns bool (True = keep)."""
+    h = _hash_mix(jnp.uint32(seed), jnp.uint32(bh).astype(jnp.uint32))
+    h = _hash_mix(h, q_pos.astype(jnp.uint32))
+    h = _hash_mix(h, k_pos.astype(jnp.uint32))
+    bits = _hash_final(h)
+    # keep iff bits < keep_prob·2^32 (compare in uint32 space).
+    threshold = jnp.uint32(
+        min(int(keep_prob * 4294967296.0), 4294967295)
+    )
+    return bits < threshold
+
+
+def _tile_dropout(p, seed, bh, qi, kj, block_q, block_k, keep_prob,
+                  transposed=False):
+    """Apply the deterministic dropout mask to a probability tile.
+    ``transposed=True`` builds the [block_k, block_q] tile the dkv kernel
+    uses (same (q, k) hash inputs, swapped iota orientation)."""
+    if transposed:
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, block_q), 0
+        )
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, block_q), 1
+        )
+    else:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+    keep = _dropout_keep(seed, bh, q_pos, k_pos, keep_prob)
+    return jnp.where(keep, p / keep_prob, 0.0)
+
+
 def _and_preds(preds):
     out = preds[0]
     for p in preds[1:]:
@@ -118,15 +180,21 @@ def _flash_kernel(
     block_q: int,
     block_k: int,
     num_k_blocks: int,
+    dropout_rate: float = 0.0,
 ):
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    pos = 3
+    qseg_ref = kseg_ref = seed_ref = None
     if has_segments:
-        (q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref,
-         m_scratch, l_scratch, acc_scratch) = refs
-    else:
-        (q_ref, k_ref, v_ref, o_ref, lse_ref,
-         m_scratch, l_scratch, acc_scratch) = refs
-        qseg_ref = kseg_ref = None
+        qseg_ref, kseg_ref = refs[pos:pos + 2]
+        pos += 2
+    if dropout_rate:
+        seed_ref = refs[pos]
+        pos += 1
+    (o_ref, lse_ref, m_scratch, l_scratch, acc_scratch) = refs[pos:]
 
+    bh = pl.program_id(0)
     qi = pl.program_id(1)
     kj = pl.program_id(2)
 
@@ -170,9 +238,17 @@ def _flash_kernel(
         p = jnp.exp(s - m_new[:, :1])  # [block_q, block_k]
         if mask is not None:
             p = jnp.where(mask, p, 0.0)
+        # Softmax normalization (l) accumulates UNdropped probabilities —
+        # dropout applies after normalization (flax semantics); only the
+        # value accumulation sees the dropped, 1/keep_prob-scaled tile.
         l_new = l_prev * alpha + jnp.broadcast_to(
             jnp.sum(p, axis=1, keepdims=True), l_prev.shape
         )
+        if dropout_rate:
+            p = _tile_dropout(
+                p, seed_ref[0, 0], bh, qi, kj, block_q, block_k,
+                1.0 - dropout_rate,
+            )
 
         acc_scratch[...] = acc_scratch[...] * alpha[:, :1] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -225,18 +301,24 @@ def _flash_bwd_dq_kernel(
     block_q: int,
     block_k: int,
     num_k_blocks: int,
+    dropout_rate: float = 0.0,
 ):
     """dQ pass: for each Q block, sweep K/V blocks (innermost grid dim),
     recompute probabilities from the saved lse, accumulate
     ``dq += (p ∘ (dp - dterm)) @ K · scale`` in VMEM scratch."""
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    pos = 3
+    qseg_ref = kseg_ref = seed_ref = None
     if has_segments:
-        (q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref, lse_ref,
-         dterm_ref, dq_ref, dq_scratch) = refs
-    else:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, dterm_ref, dq_ref,
-         dq_scratch) = refs
-        qseg_ref = kseg_ref = None
+        qseg_ref, kseg_ref = refs[pos:pos + 2]
+        pos += 2
+    if dropout_rate:
+        seed_ref = refs[pos]
+        pos += 1
+    (do_ref, lse_ref, dterm_ref, dq_ref, dq_scratch) = refs[pos:]
 
+    bh = pl.program_id(0)
     qi = pl.program_id(1)
     kj = pl.program_id(2)
 
@@ -270,6 +352,13 @@ def _flash_bwd_dq_kernel(
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [block_q, block_k]
+        if dropout_rate:
+            # ds = w ∘ (d∘dp/kp − delta): the dropout mask lands on dp; the
+            # delta term (rowsum dO∘O) already carries the dropped forward.
+            dp = _tile_dropout(
+                dp, seed_ref[0, 0], bh, qi, kj, block_q, block_k,
+                1.0 - dropout_rate,
+            )
         ds = p * (dp - dterm) * sm_scale
         dq_scratch[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -306,6 +395,9 @@ def _flash_bwd_dkv_kernel(
     block_k: int,
     num_q_blocks: int,
     total_q_iters: int,
+    dropout_rate: float = 0.0,
+    h: int = 0,
+    h_kv: int = 0,
 ):
     """dK/dV pass: for each K/V block, sweep Q blocks — and, under GQA, the
     whole query-head group — in the innermost grid dim, accumulating
@@ -314,17 +406,31 @@ def _flash_bwd_dkv_kernel(
     contraction on the MXU). One grid row per KV head: the group-summed
     gradient is written once, full f32 accumulation, no q-head-granularity
     HBM temporaries."""
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    pos = 3
+    qseg_ref = kseg_ref = seed_ref = None
     if has_segments:
-        (q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref, lse_ref,
-         dterm_ref, dk_ref, dv_ref, dk_scratch, dv_scratch) = refs
-    else:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, dterm_ref, dk_ref, dv_ref,
-         dk_scratch, dv_scratch) = refs
-        qseg_ref = kseg_ref = None
+        qseg_ref, kseg_ref = refs[pos:pos + 2]
+        pos += 2
+    if dropout_rate:
+        seed_ref = refs[pos]
+        pos += 1
+    (do_ref, lse_ref, dterm_ref, dk_ref, dv_ref,
+     dk_scratch, dv_scratch) = refs[pos:]
 
+    g0 = pl.program_id(0)  # b·h_kv + kv_head (kv-head-major grid row)
     kj = pl.program_id(1)
     it = pl.program_id(2)  # group-major: it = group_idx·num_q_blocks + qi
     qi = it % num_q_blocks
+    if dropout_rate:
+        # The dropout hash is keyed by the folded QUERY row b·h + h_idx —
+        # reconstruct it from the kv-head-major grid exactly as the q
+        # BlockSpec index map does.
+        group = h // h_kv
+        bh_q = (g0 // h_kv) * h + (g0 % h_kv) * group + it // num_q_blocks
+    else:
+        bh_q = g0
 
     @pl.when(it == 0)
     def _init():
@@ -369,12 +475,33 @@ def _flash_bwd_dkv_kernel(
         mask = _mask_t()
         if mask is not None:
             p_t = jnp.where(mask, p_t, 0.0)
+        if dropout_rate:
+            # One hash per tile, applied twice: dV sees the dropped,
+            # rescaled probabilities (the forward's value path); dK's ds
+            # keeps undropped w with the same mask landing on dp — the
+            # transposed twin of the dq kernel's math.
+            kp = 1.0 - dropout_rate
+            k_pos_t = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 0
+            )
+            q_pos_t = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 1
+            )
+            keep_t = _dropout_keep(
+                seed_ref[0, 0], bh_q, q_pos_t, k_pos_t, kp
+            )
+            p_t_drop = jnp.where(keep_t, p_t / kp, 0.0)
+        else:
+            p_t_drop = p_t
         dv_scratch[...] += jax.lax.dot_general(
-            p_t, do, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p_t_drop, do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32
         )  # [block_k, d]
         dp_t = jax.lax.dot_general(
             v, do, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [block_k, block_q]
+        if dropout_rate:
+            dp_t = jnp.where(keep_t, dp_t / kp, 0.0)
         ds_t = p_t * (dp_t - dterm) * sm_scale
         dk_scratch[...] += jax.lax.dot_general(
             ds_t, q, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -449,8 +576,20 @@ def _kv_row(h: int, h_kv: int):
     return row
 
 
-def _fwd_pallas(q, k, v, qseg, kseg, causal, window, block_q, block_k,
-                interpret):
+def _seed_spec():
+    """BlockSpec for the tiny traced dropout-seed operand ([1, 128]
+    uint32) — every grid cell reads the same (0, 0) block."""
+    return pl.BlockSpec((1, _LANES), lambda g0, g1, g2: (0, 0))
+
+
+def _seed_operand(seed):
+    return jnp.broadcast_to(
+        jnp.asarray(seed, jnp.uint32).reshape(1, 1), (1, _LANES)
+    )
+
+
+def _fwd_pallas(q, k, v, qseg, kseg, seed, causal, window, block_q, block_k,
+                interpret, dropout_rate):
     from jax.experimental.pallas import tpu as pltpu
 
     b, sq, h, d = q.shape
@@ -472,6 +611,7 @@ def _fwd_pallas(q, k, v, qseg, kseg, causal, window, block_q, block_k,
         block_q=block_q,
         block_k=block_k,
         num_k_blocks=num_k_blocks,
+        dropout_rate=dropout_rate,
     )
 
     in_specs = [
@@ -486,6 +626,9 @@ def _fwd_pallas(q, k, v, qseg, kseg, causal, window, block_q, block_k,
                        lambda g1, g2: g1, lambda g1, g2: g2)
         )
         operands += [_as_col(qseg), _as_row(kseg)]
+    if dropout_rate:
+        in_specs.append(_seed_spec())
+        operands.append(_seed_operand(seed))
 
     out, lse = pl.pallas_call(
         kernel,
@@ -516,8 +659,8 @@ def _fwd_pallas(q, k, v, qseg, kseg, causal, window, block_q, block_k,
 
 
 def _bwd_pallas(
-    q, k, v, qseg, kseg, out, lse, do, dlse, causal, window, block_q,
-    block_k, interpret
+    q, k, v, qseg, kseg, seed, out, lse, do, dlse, causal, window, block_q,
+    block_k, interpret, dropout_rate
 ):
     from jax.experimental.pallas import tpu as pltpu
 
@@ -558,6 +701,9 @@ def _bwd_pallas(
                        lambda g1, g2: g1, lambda g1, g2: g2)
         )
         dq_operands += [_as_col(qseg), _as_row(kseg)]
+    if dropout_rate:
+        dq_in_specs.append(_seed_spec())
+        dq_operands.append(_seed_operand(seed))
     dq_in_specs += [
         pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
         _row_spec(block_q, lambda g1, g2: g1),
@@ -575,6 +721,7 @@ def _bwd_pallas(
             block_q=block_q,
             block_k=block_k,
             num_k_blocks=num_k_blocks,
+            dropout_rate=dropout_rate,
         ),
         grid=(b * h, num_q_blocks, num_k_blocks),
         in_specs=dq_in_specs,
@@ -623,6 +770,9 @@ def _bwd_pallas(
             ),
         ]
         dkv_operands += [_as_row(qseg), _as_col(kseg)]
+    if dropout_rate:
+        dkv_in_specs.append(_seed_spec())
+        dkv_operands.append(_seed_operand(seed))
     dkv_in_specs += [
         pl.BlockSpec((1, block_q, d),
                      lambda g0, g1, g2: (q_row(g0, g2), q_blk(g2), 0)),
@@ -644,6 +794,9 @@ def _bwd_pallas(
             block_k=block_k,
             num_q_blocks=num_q_blocks,
             total_q_iters=total_q_iters,
+            dropout_rate=dropout_rate,
+            h=h,
+            h_kv=h_kv,
         ),
         grid=(b * h_kv, num_k_blocks, total_q_iters),
         in_specs=dkv_in_specs,
@@ -672,18 +825,19 @@ def _bwd_pallas(
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
-def _flash(q, k, v, qseg, kseg, causal, window, block_q, block_k, interpret):
-    out, lse = _fwd_pallas(q, k, v, qseg, kseg, causal, window, block_q,
-                           block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
+def _flash(q, k, v, qseg, kseg, seed, causal, window, block_q, block_k,
+           interpret, dropout_rate):
+    out, lse = _fwd_pallas(q, k, v, qseg, kseg, seed, causal, window,
+                           block_q, block_k, interpret, dropout_rate)
     return out, lse
 
 
-def _flash_fwd(q, k, v, qseg, kseg, causal, window, block_q, block_k,
-               interpret):
-    out, lse = _fwd_pallas(q, k, v, qseg, kseg, causal, window, block_q,
-                           block_k, interpret)
-    return (out, lse), (q, k, v, qseg, kseg, out, lse)
+def _flash_fwd(q, k, v, qseg, kseg, seed, causal, window, block_q, block_k,
+               interpret, dropout_rate):
+    out, lse = _fwd_pallas(q, k, v, qseg, kseg, seed, causal, window,
+                           block_q, block_k, interpret, dropout_rate)
+    return (out, lse), (q, k, v, qseg, kseg, seed, out, lse)
 
 
 def _seg_ct(seg):
@@ -694,14 +848,15 @@ def _seg_ct(seg):
     return np.zeros(seg.shape, jax.dtypes.float0)
 
 
-def _flash_bwd(causal, window, block_q, block_k, interpret, res, cotangents):
-    q, k, v, qseg, kseg, out, lse = res
+def _flash_bwd(causal, window, block_q, block_k, interpret, dropout_rate,
+               res, cotangents):
+    q, k, v, qseg, kseg, seed, out, lse = res
     do, dlse = cotangents
     dq, dk, dv = _bwd_pallas(
-        q, k, v, qseg, kseg, out, lse, do, dlse, causal, window, block_q,
-        block_k, interpret
+        q, k, v, qseg, kseg, seed, out, lse, do, dlse, causal, window,
+        block_q, block_k, interpret, dropout_rate
     )
-    return dq, dk, dv, _seg_ct(qseg), _seg_ct(kseg)
+    return dq, dk, dv, _seg_ct(qseg), _seg_ct(kseg), _seg_ct(seed)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -764,6 +919,23 @@ def _auto_block(s: int, cap: int) -> int:
     return b if b >= 8 and s % b == 0 else s
 
 
+def _check_dropout(dropout_rate, dropout_seed):
+    """Validate the in-kernel dropout config; returns (rate, seed array or
+    None)."""
+    rate = float(dropout_rate)
+    if rate == 0.0:
+        return 0.0, None
+    if not 0.0 < rate < 1.0:
+        raise ValueError(f"dropout_rate must be in [0, 1), got {rate}")
+    if dropout_seed is None:
+        raise ValueError(
+            "dropout_rate > 0 requires dropout_seed (an int or traced "
+            "uint32 scalar; derive one per step, e.g. "
+            "jax.random.bits(key, (), jnp.uint32))"
+        )
+    return rate, jnp.asarray(dropout_seed, jnp.uint32)
+
+
 def _check_window(window, causal):
     if window is None:
         return None
@@ -808,7 +980,10 @@ def _prepare(q, k, v, block_q, block_k, interpret):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "window", "block_q", "block_k", "interpret"),
+    static_argnames=(
+        "causal", "window", "block_q", "block_k", "interpret",
+        "dropout_rate",
+    ),
 )
 def flash_attention(
     q: jnp.ndarray,
@@ -821,6 +996,8 @@ def flash_attention(
     block_q: int | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
+    dropout_rate: float = 0.0,
+    dropout_seed=None,
 ) -> jnp.ndarray:
     """Memory-optimal attention over ``(batch, seq, heads, head_dim)``.
 
@@ -844,20 +1021,32 @@ def flash_attention(
     (``h % h_kv == 0``); each query head attends its group's kv head
     (Llama/Mistral GQA, MQA at ``h_kv=1``), with dK/dV group-summed in the
     backward.
+
+    In-kernel attention dropout: ``dropout_rate > 0`` with a
+    ``dropout_seed`` (traced uint32 scalar — vary it per step WITHOUT
+    retracing) drops normalized probabilities inside the kernels via a
+    counter-based position hash, O(1) extra memory. The forward and both
+    backward kernels regenerate bit-identical masks from (seed, head,
+    q_pos, k_pos); flax-style semantics (post-softmax, 1/keep_prob
+    scaling). ``dropout_rate`` itself is static (a hyperparameter).
     """
     window = _check_window(window, causal)
+    dropout_rate, seed = _check_dropout(dropout_rate, dropout_seed)
     block_q, block_k, interpret = _prepare(q, k, v, block_q, block_k, interpret)
     qseg, kseg = _normalize_segments(
         segment_ids, q.shape[0], q.shape[1], k.shape[1]
     )
-    out, _ = _flash(q, k, v, qseg, kseg, causal, window, block_q, block_k,
-                    interpret)
+    out, _ = _flash(q, k, v, qseg, kseg, seed, causal, window, block_q,
+                    block_k, interpret, dropout_rate)
     return out
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "window", "block_q", "block_k", "interpret"),
+    static_argnames=(
+        "causal", "window", "block_q", "block_k", "interpret",
+        "dropout_rate",
+    ),
 )
 def flash_attention_with_lse(
     q: jnp.ndarray,
@@ -870,6 +1059,8 @@ def flash_attention_with_lse(
     block_q: int | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
+    dropout_rate: float = 0.0,
+    dropout_seed=None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """:func:`flash_attention` that also returns the per-row logsumexp
     ``lse`` with shape ``(batch, heads, seq)`` — the merge key for combining
@@ -878,12 +1069,13 @@ def flash_attention_with_lse(
     Rows with no attendable keys report ``lse ≈ -1e30`` (zero merge weight).
     """
     window = _check_window(window, causal)
+    dropout_rate, seed = _check_dropout(dropout_rate, dropout_seed)
     block_q, block_k, interpret = _prepare(q, k, v, block_q, block_k, interpret)
     qseg, kseg = _normalize_segments(
         segment_ids, q.shape[0], q.shape[1], k.shape[1]
     )
-    return _flash(q, k, v, qseg, kseg, causal, window, block_q, block_k,
-                  interpret)
+    return _flash(q, k, v, qseg, kseg, seed, causal, window, block_q,
+                  block_k, interpret, dropout_rate)
 
 
 def _segments_from_attention_mask(mask, b, sq, sk, causal):
@@ -1057,6 +1249,7 @@ def flash_attention_fn(
     block_k: int | None = None,
     interpret: bool | None = None,
     mask_check: bool = True,
+    dropout_impl: str = "dense",
 ):
     """An ``attention_fn`` drop-in for ``nn.MultiHeadDotProductAttention``
     (e.g. ``TransformerLM(attention_fn=flash_attention_fn(causal=True))``).
@@ -1079,10 +1272,20 @@ def flash_attention_fn(
     validated (saves O(s²) boolean work per call).
 
     Attention dropout: with ``dropout_rate > 0`` and
-    ``deterministic=False`` (flax training mode), the call transparently
-    takes a dense fallback with flax-exact dropout semantics — correct,
-    but O(s²) memory; keep ``dropout_rate=0`` on long sequences.
+    ``deterministic=False`` (flax training mode),
+    ``dropout_impl="dense"`` (default) transparently takes a dense
+    fallback with flax-exact dropout semantics — correct, but O(s²)
+    memory. ``dropout_impl="kernel"`` keeps the flash path and drops
+    inside the kernels (counter-based position hash seeded from the
+    module's dropout rng): O(1) extra memory, the long-context option —
+    same post-softmax/rescale semantics, but its own random stream AND
+    structure: masks are independent per (batch, head), so flax's
+    ``broadcast_dropout=True`` (one mask shared across batch and heads)
+    is NOT honored on this path — use the dense impl if broadcast
+    regularization semantics matter.
     """
+    if dropout_impl not in ("dense", "kernel"):
+        raise ValueError("dropout_impl must be 'dense' or 'kernel'")
 
     def fn(query, key, value, bias=None, mask=None, **kwargs):
         if bias is not None:
@@ -1094,7 +1297,8 @@ def flash_attention_fn(
         # must reject exactly what the flash path rejects, not train with
         # silently-different attention.
         _check_window(window, causal)
-        dropout_rate = kwargs.get("dropout_rate", 0.0)
+        dropout_rate = float(kwargs.get("dropout_rate", 0.0))
+        dropout_seed = None
         if dropout_rate and not kwargs.get("deterministic", True):
             dropout_rng = kwargs.get("dropout_rng")
             if dropout_rng is None:
@@ -1103,10 +1307,14 @@ def flash_attention_fn(
                     "dropout_rng (flax passes it when the module is given "
                     "a 'dropout' rng collection)"
                 )
-            return _dense_dropout_attention(
-                query, key, value, mask, causal, window, dropout_rng,
-                dropout_rate, kwargs.get("broadcast_dropout", True),
-            ).astype(query.dtype)
+            if dropout_impl == "dense":
+                return _dense_dropout_attention(
+                    query, key, value, mask, causal, window, dropout_rng,
+                    dropout_rate, kwargs.get("broadcast_dropout", True),
+                ).astype(query.dtype)
+            dropout_seed = jax.random.bits(dropout_rng, (), jnp.uint32)
+        else:
+            dropout_rate = 0.0
         segment_ids = None
         fidelity = None
         if mask is not None:
@@ -1141,6 +1349,8 @@ def flash_attention_fn(
             block_q=block_q,
             block_k=block_k,
             interpret=interpret,
+            dropout_rate=dropout_rate,
+            dropout_seed=dropout_seed,
         ).astype(query.dtype)
         if fidelity is not None:
             # Unrepresentable traced mask → NaN-poison that batch row:

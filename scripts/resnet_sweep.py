@@ -116,6 +116,13 @@ def bench_config(batch, *, train=True, steps=20, head_dtype=jnp.float32):
 
 
 def main():
+    try:  # persist compiled programs across sweep invocations
+        jax.config.update(
+            "jax_compilation_cache_dir", "/tmp/fluxmpi_tpu_xla_cache"
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--trace", action="store_true")

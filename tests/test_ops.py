@@ -804,3 +804,215 @@ def test_flash_property_sweep(world, seed):
         err_msg=f"config: b={b} sq={sq} h={h} h_kv={h_kv} causal={causal} "
                 f"window={window} seg={use_seg} block={block} dtype={dtype}",
     )
+
+
+# ---- in-kernel dropout (counter-based position hash) ----
+
+
+def _kernel_dropout_oracle(q, k, v, seed, rate, causal=False):
+    """Dense attention applying the EXACT mask the kernels generate: the
+    same murmur-hash keep decision per (bh, q_pos, k_pos), post-softmax,
+    1/keep_prob scaled."""
+    from fluxmpi_tpu.ops.flash_attention import _dropout_keep
+
+    b, s, h, d = q.shape
+    kp = 1.0 - rate
+    scale = 1.0 / np.sqrt(d)
+    sc = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        pos = jnp.arange(s)[:, None] >= jnp.arange(s)[None, :]
+        sc = jnp.where(pos[None, None], sc, -1e30)
+    w = jax.nn.softmax(sc, axis=-1)
+    q_pos = jnp.broadcast_to(jnp.arange(s)[:, None], (s, s))
+    k_pos = jnp.broadcast_to(jnp.arange(s)[None, :], (s, s))
+    keep = jax.vmap(
+        lambda bh: _dropout_keep(jnp.uint32(seed), bh, q_pos, k_pos, kp)
+    )(jnp.arange(b * h, dtype=jnp.uint32))
+    keep = keep.reshape(b, h, s, s)
+    w = jnp.where(keep, w / kp, 0.0)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_kernel_dropout_matches_oracle(world, causal):
+    # The in-kernel dropout is a deterministic function of (seed, head,
+    # positions) — rebuild the identical mask at the JAX level and the
+    # outputs must agree to float tolerance.
+    from fluxmpi_tpu.ops import flash_attention
+
+    q, k, v = _qkv(s=32, seed=60)
+    out = flash_attention(
+        q, k, v, causal=causal, dropout_rate=0.3, dropout_seed=1234,
+        block_q=16, block_k=16,
+    )
+    expected = _kernel_dropout_oracle(q, k, v, 1234, 0.3, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expected), atol=2e-5
+    )
+
+
+def test_flash_kernel_dropout_grads_match_oracle(world):
+    # All three kernels regenerate the same mask: grads through the flash
+    # path equal autodiff through the dense oracle holding the mask fixed.
+    from fluxmpi_tpu.ops import flash_attention
+
+    q, k, v = _qkv(s=32, seed=61)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(jnp.sin(flash_attention(
+            q, k, v, causal=True, dropout_rate=0.25, dropout_seed=7,
+            block_q=16, block_k=16,
+        )))
+
+    def loss_dense(q, k, v):
+        return jnp.sum(jnp.sin(
+            _kernel_dropout_oracle(q, k, v, 7, 0.25, causal=True)
+        ))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_flash_kernel_dropout_statistics(world):
+    # Keep fraction ≈ keep_prob; mean output ≈ undropped output (unbiased);
+    # different seeds give different masks, same seed reproduces.
+    from fluxmpi_tpu.ops import flash_attention
+
+    q, k, v = _qkv(s=64, seed=62)
+    kwargs = dict(block_q=16, block_k=16, dropout_rate=0.5)
+    a1 = np.asarray(flash_attention(q, k, v, dropout_seed=1, **kwargs))
+    a1b = np.asarray(flash_attention(q, k, v, dropout_seed=1, **kwargs))
+    a2 = np.asarray(flash_attention(q, k, v, dropout_seed=2, **kwargs))
+    np.testing.assert_array_equal(a1, a1b)  # deterministic per seed
+    assert np.abs(a1 - a2).max() > 1e-3  # seed changes the mask
+
+    # Unbiasedness: averaging over many seeds approaches the clean output.
+    clean = np.asarray(flash_attention(q, k, v, block_q=16, block_k=16))
+    acc = np.zeros_like(clean)
+    n = 24
+    for s in range(n):
+        acc += np.asarray(flash_attention(q, k, v, dropout_seed=100 + s,
+                                          **kwargs))
+    np.testing.assert_allclose(acc / n, clean, atol=0.25)
+
+
+def test_flash_kernel_dropout_gqa_and_segments(world):
+    # Dropout composes with GQA (dkv kernel rebuilds the query-head index
+    # from its kv-head-major grid) and segment masking.
+    from fluxmpi_tpu.ops import flash_attention
+
+    rng = np.random.default_rng(63)
+    b, s, h, h_kv, d = 2, 32, 4, 2, 16
+    q = jnp.asarray(rng.normal(size=(b, s, h, d)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(b, s, h_kv, d)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(b, s, h_kv, d)).astype(np.float32))
+    seg = np.ones((b, s), np.int32)
+    seg[0, 20:] = 2
+    seg[1, 24:] = 0
+    seg = jnp.asarray(seg)
+
+    def loss(q, k, v):
+        return jnp.sum(jnp.sin(flash_attention(
+            q, k, v, causal=True, segment_ids=seg,
+            dropout_rate=0.2, dropout_seed=9, block_q=16, block_k=16,
+        )))
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    assert all(np.all(np.isfinite(np.asarray(t))) for t in g)
+
+    # Oracle: repeated-KV dense with segment mask + the kernel's hash mask
+    # (keyed by the QUERY head index — exactly what the dkv kernel must
+    # reconstruct from its kv-head-major grid).
+    from fluxmpi_tpu.ops.flash_attention import _dropout_keep
+
+    kf = jnp.repeat(k, 2, axis=2)
+    vf = jnp.repeat(v, 2, axis=2)
+    sc = jnp.einsum("bqhd,bkhd->bhqk", q, kf) / np.sqrt(d)
+    segm = (np.asarray(seg)[:, :, None] == np.asarray(seg)[:, None, :]) & (
+        np.asarray(seg)[:, None, :] != 0
+    )
+    pos = np.arange(s)[:, None] >= np.arange(s)[None, :]
+    mask = jnp.asarray(segm[:, None] & pos[None, None])
+    sc = jnp.where(mask, sc, -1e30)
+    w = jax.nn.softmax(sc, axis=-1)
+    w = jnp.where(mask, w, 0.0)  # fully-masked rows: uniform → zero
+    q_pos = jnp.broadcast_to(jnp.arange(s)[:, None], (s, s))
+    k_pos = jnp.broadcast_to(jnp.arange(s)[None, :], (s, s))
+    keep = jax.vmap(
+        lambda bh: _dropout_keep(jnp.uint32(9), bh, q_pos, k_pos, 0.8)
+    )(jnp.arange(b * h, dtype=jnp.uint32)).reshape(b, h, s, s)
+    w = jnp.where(keep, w / 0.8, 0.0)
+    expected = jnp.einsum("bhqk,bkhd->bqhd", w, vf)
+    out = flash_attention(
+        q, k, v, causal=True, segment_ids=seg,
+        dropout_rate=0.2, dropout_seed=9, block_q=16, block_k=16,
+    )
+    valid = np.asarray(seg) != 0
+    np.testing.assert_allclose(
+        np.asarray(out)[valid], np.asarray(expected)[valid], atol=2e-5
+    )
+
+    # Backward oracle too: autodiff through the same dense hash-masked
+    # formulation — a wrong bh_q reconstruction in the dkv kernel would
+    # pass the forward check and finite-grad check but fail here.
+    def oracle_loss(q, k, v):
+        kf = jnp.repeat(k, 2, axis=2)
+        vf = jnp.repeat(v, 2, axis=2)
+        sc = jnp.einsum("bqhd,bkhd->bhqk", q, kf) / np.sqrt(d)
+        sc = jnp.where(mask, sc, -1e30)
+        w = jax.nn.softmax(sc, axis=-1)
+        w = jnp.where(mask, w, 0.0)
+        w = jnp.where(keep, w / 0.8, 0.0)
+        o = jnp.einsum("bhqk,bkhd->bqhd", w, vf)
+        vmask = jnp.asarray(valid)[:, :, None, None]
+        return jnp.sum(jnp.where(vmask, jnp.sin(o), 0.0))
+
+    def flash_loss(q, k, v):
+        o = flash_attention(
+            q, k, v, causal=True, segment_ids=seg,
+            dropout_rate=0.2, dropout_seed=9, block_q=16, block_k=16,
+        )
+        vmask = jnp.asarray(valid)[:, :, None, None]
+        return jnp.sum(jnp.where(vmask, jnp.sin(o), 0.0))
+
+    gf = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(oracle_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_flash_fn_kernel_dropout_path(world):
+    # dropout_impl="kernel" on the adapter: stays on the flash path, trains
+    # through a flax module, deterministic under a fixed rng.
+    import flax.linen as nn
+
+    from fluxmpi_tpu.ops import flash_attention_fn
+
+    attn = nn.MultiHeadDotProductAttention(
+        num_heads=4, qkv_features=32, dropout_rate=0.2,
+        attention_fn=flash_attention_fn(causal=True, dropout_impl="kernel"),
+    )
+    x = jnp.asarray(
+        np.random.default_rng(64).normal(size=(2, 16, 32)).astype(np.float32)
+    )
+    params = attn.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        x, x, deterministic=False,
+    )
+
+    def loss_fn(p, rng):
+        y = attn.apply(p, x, x, deterministic=False, rngs={"dropout": rng})
+        return jnp.mean(y**2)
+
+    g1 = jax.jit(jax.grad(loss_fn))(params, jax.random.PRNGKey(2))
+    g2 = jax.jit(jax.grad(loss_fn))(params, jax.random.PRNGKey(2))
+    for a, b in zip(jax.tree_util.tree_leaves(g1),
+                    jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert all(np.all(np.isfinite(np.asarray(t)))
+               for t in jax.tree_util.tree_leaves(g1))
+
+    with pytest.raises(ValueError, match="dropout_impl"):
+        flash_attention_fn(dropout_impl="bogus")
