@@ -343,3 +343,82 @@ def test_vit_with_flash_attention(world):
         np.asarray(flash.apply(variables, x, train=False)),
         atol=3e-5,
     )
+
+
+# ---- Anderson-accelerated DEQ solver ----
+
+
+def test_anderson_matches_damped_fixed_point(world):
+    # Same cell, same tolerance: both solvers land on the same fixed point,
+    # Anderson in (far) fewer iterations.
+    from fluxmpi_tpu.models.deq import _anderson_iteration, _damped_iteration
+
+    rng = np.random.default_rng(70)
+    d = 32
+    W = jnp.asarray(
+        (rng.normal(size=(d, d)) * 0.2 / np.sqrt(d)).astype(np.float32)
+    )
+    b = jnp.asarray(rng.normal(size=(d,)).astype(np.float32))
+
+    def g(z):
+        return jnp.tanh(z @ W + b)
+
+    z0 = jnp.zeros((8, d), jnp.float32)
+    z_damped, it_damped = _damped_iteration(g, z0, 1e-6, 500, 0.7)
+    z_anderson, it_anderson = _anderson_iteration(g, z0, 1e-6, 500, m=5)
+    np.testing.assert_allclose(
+        np.asarray(z_anderson), np.asarray(z_damped), atol=1e-4
+    )
+    assert int(it_anderson) < int(it_damped), (
+        int(it_anderson), int(it_damped),
+    )
+
+
+def test_deq_anderson_grads_match_damped(world):
+    # The implicit gradients are solver-independent (same z*, same IFT
+    # adjoint solution).
+    from fluxmpi_tpu.models import DEQ
+
+    rng = np.random.default_rng(71)
+    x = jnp.asarray(rng.normal(size=(16, 3)).astype(np.float32))
+    y = jnp.asarray(rng.normal(size=(16, 1)).astype(np.float32))
+
+    kw = dict(hidden=32, out=1, tol=1e-6, max_iter=300)
+    damped = DEQ(**kw, solver="damped")
+    anderson = DEQ(**kw, solver="anderson")
+    params = damped.init(jax.random.PRNGKey(0), x)
+
+    def loss(model):
+        return lambda p: jnp.mean((model.apply(p, x) - y) ** 2)
+
+    ld, gd = jax.value_and_grad(loss(damped))(params)
+    la, ga = jax.value_and_grad(loss(anderson))(params)
+    np.testing.assert_allclose(float(la), float(ld), rtol=1e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(ga),
+                    jax.tree_util.tree_leaves(gd)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-3)
+
+
+def test_deq_anderson_trains_under_dp(world):
+    from fluxmpi_tpu.models import DEQ
+    from fluxmpi_tpu.parallel import TrainState, make_train_step
+    from fluxmpi_tpu.parallel.train import replicate, shard_batch
+
+    model = DEQ(hidden=32, out=1, solver="anderson")
+    rng = np.random.default_rng(72)
+    xs = jnp.asarray(rng.uniform(-2, 2, size=(32, 1)).astype(np.float32))
+    ys = xs**2
+    params = model.init(jax.random.PRNGKey(0), xs[:2])
+
+    def loss_fn(p, ms, batch):
+        bx, by = batch
+        return jnp.mean((model.apply(p, bx) - by) ** 2), ms
+
+    step = make_train_step(loss_fn, optax.adam(1e-2), donate=False)
+    state = replicate(TrainState.create(params, optax.adam(1e-2)))
+    batch = shard_batch((xs, ys))
+    losses = []
+    for _ in range(20):
+        state, loss = step(state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
