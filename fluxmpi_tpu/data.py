@@ -155,6 +155,15 @@ class DistributedDataLoader:
       mesh: defaults to the runtime's global mesh.
       axis_name: mesh axis to shard the batch dimension over.
       shuffle/seed: reshuffle shard indices each epoch with a per-epoch key.
+      global_shuffle: reshuffle the assignment of samples to workers each
+        epoch — a seeded permutation of the FULL dataset, of which this
+        process takes its contiguous slice (every process computes the
+        same permutation, so no communication). The reference's fixed
+        contiguous shards (src/data.jl:14-19) mean a worker only ever
+        sees its own slice; global shuffling restores i.i.d. batches
+        across the whole dataset. Requires ``data`` to be a
+        :class:`DistributedDataContainer` (the full-dataset view is what
+        gets permuted). Implies ``shuffle``.
       drop_last: drop the trailing incomplete batch (default True — a ragged
         final batch would retrigger XLA compilation).
       prefetch: keep this many global batches ahead of the consumer with
@@ -174,10 +183,17 @@ class DistributedDataLoader:
         mesh: Mesh | None = None,
         axis_name: str | None = None,
         shuffle: bool = False,
+        global_shuffle: bool = False,
         seed: int = 0,
         drop_last: bool = True,
         prefetch: int = 2,
     ):
+        if global_shuffle and not isinstance(data, DistributedDataContainer):
+            raise ValueError(
+                "global_shuffle reshuffles the sample→worker assignment, "
+                "which needs the full-dataset view of a "
+                "DistributedDataContainer; wrap the dataset in one"
+            )
         self.data = data
         self.mesh = mesh
         self.axis_name = axis_name or config.DP_AXIS_NAME
@@ -203,7 +219,8 @@ class DistributedDataLoader:
                     f"by the '{axis}' mesh axis size {axis_size} so every "
                     f"device gets an equal slice"
                 )
-        self.shuffle = shuffle
+        self.shuffle = shuffle or global_shuffle
+        self.global_shuffle = global_shuffle
         self.seed = seed
         self.drop_last = drop_last
         if prefetch < 0:
@@ -282,16 +299,36 @@ class DistributedDataLoader:
             yield queue.popleft()
 
     def _iter_batches(self) -> Iterator[Any]:
-        n = len(self.data)
-        order = np.arange(n)
-        if self.shuffle:
+        if self.global_shuffle:
+            # Same seeded permutation of the FULL dataset on every process
+            # (no communication); this process takes the contiguous slice
+            # of the permutation matching its ceil-partition bounds, so
+            # shard sizes — and the lockstep batch count — are identical
+            # to the fixed-shard layout.
+            cont = self.data
             rng = np.random.default_rng(self.seed + self._epoch)
-            rng.shuffle(order)
+            perm = rng.permutation(cont.total_size)
+            # Slice by the container's own ceil-partition bounds — shard
+            # sizes (and the lockstep batch count) stay identical to the
+            # fixed-shard layout by construction.
+            order = perm[cont.idxs.start : cont.idxs.stop]
+            source = cont.data
+            backing = (
+                (source.arrays, 0)
+                if isinstance(source, ArrayDataset)
+                else None
+            )
+        else:
+            source = self.data
+            order = np.arange(len(source))
+            if self.shuffle:
+                rng = np.random.default_rng(self.seed + self._epoch)
+                rng.shuffle(order)
+            backing = self._array_backing()
         self._epoch += 1
         sharding = self._sharding()
 
         nbatches = len(self)
-        backing = self._array_backing()
 
         def _globalize(batch):
             return jax.tree_util.tree_map(
@@ -339,5 +376,5 @@ class DistributedDataLoader:
             # cross-process global-array assembly.
             stop = min((b + 1) * self.local_batch_size, self._common_len)
             idxs = order[b * self.local_batch_size : stop]
-            batch = _stack_samples([self.data[int(i)] for i in idxs])
+            batch = _stack_samples([source[int(i)] for i in idxs])
             yield _globalize(batch)

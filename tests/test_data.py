@@ -250,3 +250,67 @@ def test_prefetch_matches_unprefetched(world):
     )
     for ba, bb in zip(a, b):
         np.testing.assert_array_equal(np.asarray(ba[0]), np.asarray(bb[0]))
+
+
+def test_global_shuffle_covers_and_reshards(world):
+    # Global shuffle: the union of every simulated rank's epoch is exactly
+    # the dataset (conservation), the assignment CHANGES across epochs
+    # (unlike fixed shards), every rank computes the same permutation
+    # (determinism), and batch counts stay in lockstep.
+    import fluxmpi_tpu as fm
+
+    n, w = 32, 4
+    xs = np.arange(n, dtype=np.float32).reshape(n, 1)
+    ds = fm.ArrayDataset((xs,))
+
+    def epoch_values(rank, epoch_skip=0):
+        cont = fm.DistributedDataContainer(ds, rank=rank, world=w)
+        loader = fm.DistributedDataLoader(
+            cont, 8, global_shuffle=True, seed=9, prefetch=0
+        )
+        for _ in range(epoch_skip):
+            for _ in loader:
+                pass
+        return np.concatenate(
+            [np.asarray(b[0]).ravel() for b in loader]
+        )
+
+    e0 = [epoch_values(r) for r in range(w)]
+    assert sorted(np.concatenate(e0).tolist()) == xs.ravel().tolist()
+    # Epoch 1 assigns rank 0 a different slice than epoch 0.
+    e1_rank0 = epoch_values(0, epoch_skip=1)
+    assert not np.array_equal(np.sort(e0[0]), np.sort(e1_rank0))
+    # Same seed, same rank → identical epoch.
+    np.testing.assert_array_equal(e0[1], epoch_values(1))
+    # Lockstep batch counts across ranks.
+    counts = {
+        len(list(fm.DistributedDataLoader(
+            fm.DistributedDataContainer(ds, rank=r, world=w), 8,
+            global_shuffle=True, prefetch=0,
+        ))) for r in range(w)
+    }
+    assert len(counts) == 1
+
+    with pytest.raises(ValueError, match="global_shuffle"):
+        fm.DistributedDataLoader(ds, 8, global_shuffle=True)
+
+
+def test_global_shuffle_generic_dataset(world):
+    # The non-array (generic __getitem__) path takes the same permuted
+    # slice.
+    import fluxmpi_tpu as fm
+
+    class Generic:
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            return np.float32(i)
+
+    cont = fm.DistributedDataContainer(Generic(), rank=0, world=2)
+    loader = fm.DistributedDataLoader(
+        cont, 8, global_shuffle=True, seed=3, prefetch=0
+    )
+    vals = np.concatenate([np.asarray(b).ravel() for b in loader])
+    perm = np.random.default_rng(3).permutation(16)
+    np.testing.assert_array_equal(vals, perm[:8].astype(np.float32))

@@ -405,22 +405,18 @@ def test_pipeline_composes_with_dp(world):
         np.random.default_rng(51).normal(size=(B, d)).astype(np.float32)
     )
 
+    from fluxmpi_tpu.parallel._compat import shard_map_unchecked
+
     def body(params, xx):
         return pipeline_apply(
             _stage_fn, params, xx, n_microbatches=n_micro,
             axis_name="pp", input_sharded=True,
         )
 
-    try:
-        sm = jax.shard_map
-    except AttributeError:
-        from jax.experimental.shard_map import shard_map as sm
-
-    mapped = sm(
-        body, mesh=mesh,
+    mapped = shard_map_unchecked(
+        body, mesh,
         in_specs=(P("pp"), P(("dp", "pp"))),
         out_specs=P(("dp", "pp")),
-        check_vma=False,
     )
     y = jax.jit(mapped)(stacked, x)
     ref = _sequential(stages, x)
