@@ -48,6 +48,23 @@ def _damped_iteration(g: Callable, z0: jnp.ndarray, tol: float, max_iter: int,
     return z_final, iters
 
 
+def _flatten_batched(g: Callable, z0: jnp.ndarray):
+    """Shared solver scaffolding: view ``z`` as ``[n, d]`` f32 (batched
+    per leading axis, trailing shape flattened) and wrap ``g``
+    accordingly. Returns ``(gf, z0_flat, unflatten)``."""
+    orig_shape = z0.shape
+    n = orig_shape[0] if z0.ndim > 1 else 1
+    z0f = z0.reshape(n, -1).astype(jnp.float32)
+
+    def gf(zf):
+        return g(zf.reshape(orig_shape)).reshape(n, -1).astype(jnp.float32)
+
+    def unflatten(zf):
+        return zf.reshape(orig_shape).astype(z0.dtype)
+
+    return gf, z0f, unflatten
+
+
 def _anderson_iteration(
     g: Callable, z0: jnp.ndarray, tol: float, max_iter: int,
     m: int = 5, beta: float = 1.0, ridge: float = 1e-8,
@@ -64,13 +81,8 @@ def _anderson_iteration(
     Batched per sample over the leading axis; ``z`` may have any trailing
     shape (flattened internally).
     """
-    orig_shape = z0.shape
-    n = orig_shape[0] if z0.ndim > 1 else 1
-    z0f = z0.reshape(n, -1).astype(jnp.float32)
-    d = z0f.shape[1]
-
-    def gf(zf):
-        return g(zf.reshape(orig_shape)).reshape(n, -1).astype(jnp.float32)
+    gf, z0f, unflatten = _flatten_batched(g, z0)
+    n, d = z0f.shape
 
     # Seed the history with min(m, max_iter) plain iterations (statically
     # unrolled) — the documented max_iter budget bounds TOTAL cell
@@ -115,7 +127,70 @@ def _anderson_iteration(
     z_final, _, _, _, iters = jax.lax.while_loop(
         cond, body, (z, Z[m_seed - 1], Z, F, jnp.asarray(m_seed))
     )
-    return z_final.reshape(orig_shape).astype(z0.dtype), iters
+    return unflatten(z_final), iters
+
+
+def _broyden_iteration(
+    g: Callable, z0: jnp.ndarray, tol: float, max_iter: int, m: int = 8,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Limited-memory 'good Broyden' root solve of ``F(z) = g(z) - z = 0``
+    — the FastDEQ-default solver family. The inverse-Jacobian estimate is
+    ``B = -I + Σ u_i v_iᵀ`` held as two fixed ``[m, n, d]`` histories.
+    When the window fills, the history RESETS to ``B = -I`` rather than
+    overwriting the oldest pair — each stored pair was computed against a
+    ``B`` that included every earlier pair, so dropping one would leave a
+    representation that satisfies no secant condition at all (a reset
+    keeps ``B`` valid at the cost of re-learning curvature). Each step
+    costs two history matvecs + one ``g``. Batched per sample, one
+    ``lax.while_loop``, static shapes. Returns ``(z*, iterations)``."""
+    gf, z, unflatten = _flatten_batched(g, z0)
+    n, d = z.shape
+
+    def B_apply(U, V, x):
+        # B x = -x + Σ_i u_i (v_i·x)   (histories [m, n, d], x [n, d])
+        coef = jnp.einsum("mnd,nd->mn", V, x)
+        return -x + jnp.einsum("mnd,mn->nd", U, coef)
+
+    def BT_apply(U, V, x):
+        # Bᵀ x = -x + Σ_i v_i (u_i·x)
+        coef = jnp.einsum("mnd,nd->mn", U, x)
+        return -x + jnp.einsum("mnd,mn->nd", V, coef)
+
+    F0 = gf(z) - z
+    U = jnp.zeros((m, n, d), jnp.float32)
+    V = jnp.zeros((m, n, d), jnp.float32)
+
+    def cond(carry):
+        z, F, U, V, it = carry
+        return jnp.logical_and(it < max_iter, jnp.max(jnp.abs(F)) > tol)
+
+    def body(carry):
+        z, F, U, V, it = carry
+        dz = -B_apply(U, V, F)  # Newton-ish step: z ← z − B F
+        z_new = z + dz
+        F_new = gf(z_new) - z_new
+        dF = F_new - F
+        # Window full → reset to B = -I BEFORE the secant update, so the
+        # stored pairs always form a valid cumulative representation.
+        slot = (it - 1) % m
+        do_reset = jnp.logical_and(slot == 0, it > 1)
+        U = jnp.where(do_reset, jnp.zeros_like(U), U)
+        V = jnp.where(do_reset, jnp.zeros_like(V), V)
+        # Good-Broyden rank-1 update: u = (Δz − B ΔF)/(Δzᵀ B ΔF),
+        # v = Bᵀ Δz; guarded against tiny curvature denominators.
+        BdF = B_apply(U, V, dF)
+        denom = jnp.sum(dz * BdF, axis=1, keepdims=True)  # [n, 1]
+        safe = jnp.abs(denom) > 1e-12
+        u = jnp.where(safe, (dz - BdF) / jnp.where(safe, denom, 1.0), 0.0)
+        v = jnp.where(safe, BT_apply(U, V, dz), 0.0)
+        U = jax.lax.dynamic_update_index_in_dim(U, u, slot, 0)
+        V = jax.lax.dynamic_update_index_in_dim(V, v, slot, 0)
+        return z_new, F_new, U, V, it + 1
+
+    z_final, _, _, _, iters = jax.lax.while_loop(
+        cond, body, (z, F0, U, V, jnp.asarray(1))
+    )
+    return unflatten(z_final), iters
 
 
 def _solve(g, z0, tol, max_iter, damping, solver, anderson_m, anderson_beta):
@@ -125,7 +200,11 @@ def _solve(g, z0, tol, max_iter, damping, solver, anderson_m, anderson_beta):
         return _anderson_iteration(
             g, z0, tol, max_iter, m=anderson_m, beta=anderson_beta
         )
-    raise ValueError(f"unknown solver {solver!r} (damped | anderson)")
+    if solver == "broyden":
+        return _broyden_iteration(g, z0, tol, max_iter, m=anderson_m)
+    raise ValueError(
+        f"unknown solver {solver!r} (damped | anderson | broyden)"
+    )
 
 
 from functools import partial as _partial
@@ -139,7 +218,10 @@ def fixed_point_solve(f, params, x, z0, tol, max_iter, damping,
     ``solver="damped"`` iterates ``z ← (1-λ)z + λ f(z)``;
     ``solver="anderson"`` runs Anderson acceleration with history
     ``anderson_m`` and mixing ``anderson_beta`` (same fixed point, far
-    fewer ``f`` evaluations on contractive cells). ``f`` and the scalar
+    fewer ``f`` evaluations on contractive cells);
+    ``solver="broyden"`` runs limited-memory good-Broyden root finding on
+    ``f(z) − z`` (window ``anderson_m`` — the FastDEQ-default family,
+    strongest on stiff/non-contractive cells). ``f`` and the scalar
     knobs must be static (hashable / Python scalars); ``params``/``x``/
     ``z0`` are pytrees/arrays. Gradients flow via the implicit-function
     theorem — the backward adjoint equation is solved with the SAME
@@ -189,7 +271,7 @@ class DEQ(nn.Module):
     tol: float = 1e-4
     max_iter: int = 50
     damping: float = 0.7
-    solver: str = "damped"  # or "anderson" (fewer cell evals, same z*)
+    solver: str = "damped"  # "anderson" | "broyden" accelerate (same z*)
     anderson_m: int = 5
     anderson_beta: float = 1.0
 

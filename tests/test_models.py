@@ -422,3 +422,48 @@ def test_deq_anderson_trains_under_dp(world):
         state, loss = step(state, batch)
         losses.append(float(loss))
     assert losses[-1] < losses[0]
+
+
+def test_broyden_matches_damped_fixed_point(world):
+    from fluxmpi_tpu.models.deq import _broyden_iteration, _damped_iteration
+
+    rng = np.random.default_rng(73)
+    d = 32
+    W = jnp.asarray(
+        (rng.normal(size=(d, d)) * 0.2 / np.sqrt(d)).astype(np.float32)
+    )
+    b = jnp.asarray(rng.normal(size=(d,)).astype(np.float32))
+
+    def g(z):
+        return jnp.tanh(z @ W + b)
+
+    z0 = jnp.zeros((8, d), jnp.float32)
+    z_damped, it_damped = _damped_iteration(g, z0, 1e-6, 500, 0.7)
+    z_broyden, it_broyden = _broyden_iteration(g, z0, 1e-6, 500, m=8)
+    np.testing.assert_allclose(
+        np.asarray(z_broyden), np.asarray(z_damped), atol=1e-4
+    )
+    assert int(it_broyden) < int(it_damped)
+
+
+def test_deq_broyden_grads_match_damped(world):
+    from fluxmpi_tpu.models import DEQ
+
+    rng = np.random.default_rng(74)
+    x = jnp.asarray(rng.normal(size=(16, 3)).astype(np.float32))
+    y = jnp.asarray(rng.normal(size=(16, 1)).astype(np.float32))
+
+    kw = dict(hidden=32, out=1, tol=1e-6, max_iter=300)
+    damped = DEQ(**kw, solver="damped")
+    broyden = DEQ(**kw, solver="broyden")
+    params = damped.init(jax.random.PRNGKey(0), x)
+
+    def loss(model):
+        return lambda p: jnp.mean((model.apply(p, x) - y) ** 2)
+
+    ld, gd = jax.value_and_grad(loss(damped))(params)
+    lb, gb = jax.value_and_grad(loss(broyden))(params)
+    np.testing.assert_allclose(float(lb), float(ld), rtol=1e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(gb),
+                    jax.tree_util.tree_leaves(gd)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-3)
