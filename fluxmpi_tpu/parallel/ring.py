@@ -156,9 +156,22 @@ def _lse_merge(o, lse, o_blk, lse_blk):
     return o * w_prev + o_blk.astype(jnp.float32) * w_blk, lse_new
 
 
+def _fold_seed(seed, *salts):
+    """Per-call-site dropout seed: fold traced/static salts (device index,
+    ring tick, attend id) into the base seed so every block attend draws an
+    independent mask stream — the kernels mix further, so simple odd-
+    constant multiplies suffice here."""
+    s = jnp.asarray(seed, jnp.uint32)
+    consts = (0x9E3779B1, 0x85EBCA6B, 0xC2B2AE35, 0x27D4EB2F)
+    for salt, c in zip(salts, consts):
+        s = s + (jnp.asarray(salt).astype(jnp.uint32) + 1) * jnp.uint32(c)
+    return s
+
+
 def _ring_flash(
     q, k, v, *, name: str, causal: bool, n: int, idx, qseg, kseg,
-    block_q: int | None, block_k: int | None
+    block_q: int | None, block_k: int | None,
+    dropout_rate: float = 0.0, dropout_seed=None,
 ):
     """Ring accumulation with the Pallas flash kernel as the local block
     attend (:func:`fluxmpi_tpu.ops.flash_attention_with_lse`).
@@ -167,6 +180,12 @@ def _ring_flash(
     *normalized* block output plus its logsumexp; blocks merge in plain JAX
     via the standard lse-weighted combine. The kernel's custom VJP honors
     the lse cotangent, so the whole ring differentiates exactly.
+
+    Attention dropout composes exactly with the merge: the kernel
+    accumulates softmax normalization from UNdropped probabilities, so the
+    lse-weighted combine of dropped block outputs equals global
+    post-softmax dropout. Each (device, tick) attend folds its coordinates
+    into the seed — independent masks per resident block.
     """
     from ..ops.flash_attention import flash_attention_with_lse
 
@@ -176,11 +195,15 @@ def _ring_flash(
     perm = [(i, (i + 1) % n) for i in range(n)]
     has_seg = qseg is not None
 
-    def attend(k_blk, v_blk, kseg_blk, local_causal):
+    def attend(k_blk, v_blk, kseg_blk, local_causal, src):
         seg = (qseg, kseg_blk) if has_seg else None
+        seed = (
+            _fold_seed(dropout_seed, idx, src) if dropout_rate else None
+        )
         return flash_attention_with_lse(
             q, k_blk, v_blk, causal=local_causal, segment_ids=seg,
-            block_q=block_q, block_k=block_k
+            block_q=block_q, block_k=block_k,
+            dropout_rate=dropout_rate, dropout_seed=seed,
         )
 
     def body(s, carry):
@@ -190,12 +213,12 @@ def _ring_flash(
         src = (idx - s) % n
 
         def full_blk(_):
-            return attend(k_blk, v_blk, kseg_blk, False)
+            return attend(k_blk, v_blk, kseg_blk, False, src)
 
         if causal:
             def diag_blk(_):
                 # Same ring position: global offsets cancel, local causal.
-                return attend(k_blk, v_blk, kseg_blk, True)
+                return attend(k_blk, v_blk, kseg_blk, True, src)
 
             def skip_blk(_):
                 return (
@@ -227,7 +250,8 @@ def _ring_flash(
 
 def _local_attend(
     q, k, v, *, causal, segment_ids=None, use_flash=False,
-    block_q=None, block_k=None, window=None
+    block_q=None, block_k=None, window=None,
+    dropout_rate=0.0, dropout_seed=None,
 ):
     """Single-device attention with ring semantics — the n=1 ring. Used as
     the unbound-axis fallback so ring/zigzag models initialize and run
@@ -240,6 +264,12 @@ def _local_attend(
         return flash_attention(
             q, k, v, causal=causal, segment_ids=segment_ids,
             block_q=block_q, block_k=block_k, window=window,
+            dropout_rate=dropout_rate, dropout_seed=dropout_seed,
+        )
+    if dropout_rate:
+        raise ValueError(
+            "attention dropout on the SP layers requires use_flash=True "
+            "(the in-kernel mask; the dense debug paths do not implement it)"
         )
     qseg, kseg = _normalize_ring_segments(
         segment_ids, q.shape[0], q.shape[1], k.shape[1]
@@ -291,6 +321,8 @@ def ring_attention(
     block_q: int | None = None,
     block_k: int | None = None,
     window: int | None = None,
+    dropout_rate: float = 0.0,
+    dropout_seed=None,
 ) -> jnp.ndarray:
     """Blockwise ring attention; call inside ``shard_map`` with the sequence
     dimension of q/k/v sharded over ``axis_name``.
@@ -322,6 +354,16 @@ def ring_attention(
     """
     if window is not None and not causal:
         raise ValueError("window (sliding-window attention) requires causal=True")
+    if dropout_rate and not use_flash:
+        raise ValueError(
+            "ring_attention dropout requires use_flash=True (in-kernel "
+            "position-hash masks; see flash_attention dropout_rate)"
+        )
+    if dropout_rate and dropout_seed is None:
+        raise ValueError(
+            "dropout_rate > 0 requires dropout_seed (an int or traced "
+            "uint32 scalar)"
+        )
     name = axis_name or config.SP_AXIS_NAME
     try:
         n = jax.lax.axis_size(name)
@@ -335,6 +377,7 @@ def ring_attention(
             q, k, v, causal=causal, segment_ids=segment_ids,
             use_flash=use_flash, block_q=block_q, block_k=block_k,
             window=window,
+            dropout_rate=dropout_rate, dropout_seed=dropout_seed,
         )
     idx = jax.lax.axis_index(name)
     b, sq, h, d = q.shape
@@ -352,6 +395,7 @@ def ring_attention(
         return _ring_flash(
             q, k, v, name=name, causal=causal, n=n, idx=idx,
             qseg=qseg, kseg=kseg, block_q=block_q, block_k=block_k,
+            dropout_rate=dropout_rate, dropout_seed=dropout_seed,
         )
 
     o = jnp.zeros_like(q, dtype=jnp.float32)
@@ -462,6 +506,8 @@ def zigzag_ring_attention(
     use_flash: bool = False,
     block_q: int | None = None,
     block_k: int | None = None,
+    dropout_rate: float = 0.0,
+    dropout_seed=None,
 ) -> jnp.ndarray:
     """Causal ring attention with the zigzag-balanced schedule; call inside
     ``shard_map`` on arrays pre-permuted with :func:`zigzag_indices`.
@@ -488,6 +534,16 @@ def zigzag_ring_attention(
     """
     from ..ops.flash_attention import flash_attention_with_lse
 
+    if dropout_rate and not use_flash:
+        raise ValueError(
+            "zigzag_ring_attention dropout requires use_flash=True "
+            "(in-kernel position-hash masks)"
+        )
+    if dropout_rate and dropout_seed is None:
+        raise ValueError(
+            "dropout_rate > 0 requires dropout_seed (an int or traced "
+            "uint32 scalar)"
+        )
     name = axis_name or config.SP_AXIS_NAME
     try:
         n = jax.lax.axis_size(name)
@@ -497,6 +553,7 @@ def zigzag_ring_attention(
         return _local_attend(
             q, k, v, causal=True, segment_ids=segment_ids,
             use_flash=use_flash, block_q=block_q, block_k=block_k,
+            dropout_rate=dropout_rate, dropout_seed=dropout_seed,
         )
     idx = jax.lax.axis_index(name)
     b, sq, h, d = q.shape
@@ -506,13 +563,18 @@ def zigzag_ring_attention(
     qseg, kseg = _normalize_ring_segments(segment_ids, b, sq, k.shape[1])
     has_seg = qseg is not None
 
-    def attend(qc, kc, vc, local_causal, qs=None, ks=None):
+    def attend(qc, kc, vc, local_causal, qs=None, ks=None, attend_id=0):
         seg = (qs, ks) if qs is not None else None
         if use_flash:
+            seed = (
+                _fold_seed(dropout_seed, idx, attend_id)
+                if dropout_rate else None
+            )
             return flash_attention_with_lse(
                 qc, kc, vc, causal=local_causal, segment_ids=seg,
                 block_q=None if block_q is None else min(block_q, c),
                 block_k=None if block_k is None else min(block_k, c),
+                dropout_rate=dropout_rate, dropout_seed=seed,
             )
         return _dense_with_lse(qc, kc, vc, local_causal, qs, ks)
 
@@ -532,15 +594,15 @@ def zigzag_ring_attention(
     kv_lo_v, kv_hi_v = split(v)
     ks_lo, ks_hi = split(kseg) if has_seg else (None, None)
     o_blk, lse_blk = attend(
-        q_lo, kv_lo_k, kv_lo_v, True, qseg_lo, ks_lo
+        q_lo, kv_lo_k, kv_lo_v, True, qseg_lo, ks_lo, attend_id=0
     )  # (lo, lo, diag)
     o_lo, lse_lo = _lse_merge(o_lo, lse_lo, o_blk, lse_blk)
     o_blk, lse_blk = attend(
-        q_hi, kv_lo_k, kv_lo_v, False, qseg_hi, ks_lo
+        q_hi, kv_lo_k, kv_lo_v, False, qseg_hi, ks_lo, attend_id=1
     )  # (hi, lo, full)
     o_hi, lse_hi = _lse_merge(o_hi, lse_hi, o_blk, lse_blk)
     o_blk, lse_blk = attend(
-        q_hi, kv_hi_k, kv_hi_v, True, qseg_hi, ks_hi
+        q_hi, kv_hi_k, kv_hi_v, True, qseg_hi, ks_hi, attend_id=2
     )  # (hi, hi, diag)
     o_hi, lse_hi = _lse_merge(o_hi, lse_hi, o_blk, lse_blk)
 
@@ -559,7 +621,8 @@ def zigzag_ring_attention(
 
         # Always: (hi, lo, full) — q_hi = chunk 2n-1-idx is in the future of
         # every lo chunk src < n.
-        o_blk, lse_blk = attend(q_hi, klo, vlo, False, qseg_hi, kslo)
+        o_blk, lse_blk = attend(q_hi, klo, vlo, False, qseg_hi, kslo,
+                                attend_id=1 + 2 * s)
         o_hi, lse_hi = _lse_merge(o_hi, lse_hi, o_blk, lse_blk)
 
         # Predicate-selected second attend: src < idx → (lo, lo, full);
@@ -572,7 +635,8 @@ def zigzag_ring_attention(
         v_sel = jnp.where(pred, vlo, vhi)
         qs_sel = jnp.where(pred, qseg_lo, qseg_hi) if has_seg else None
         ks_sel = jnp.where(pred, kslo, kshi) if has_seg else None
-        o_blk, lse_blk = attend(q_sel, k_sel, v_sel, False, qs_sel, ks_sel)
+        o_blk, lse_blk = attend(q_sel, k_sel, v_sel, False, qs_sel, ks_sel,
+                                attend_id=2 + 2 * s)
         new_lo = _lse_merge(o_lo, lse_lo, o_blk, lse_blk)
         new_hi = _lse_merge(o_hi, lse_hi, o_blk, lse_blk)
         o_lo = jnp.where(pred, new_lo[0], o_lo)
@@ -586,6 +650,23 @@ def zigzag_ring_attention(
         1, n, body, (o_lo, lse_lo, o_hi, lse_hi, k, v, kseg0)
     )
     return jnp.concatenate([o_lo, o_hi], axis=1).astype(q.dtype)
+
+
+def _adapter_dropout(kwargs):
+    """Flax-adapter dropout plumbing shared by the SP ``attention_fn``
+    wrappers: read the module-passed dropout kwargs and derive an
+    in-kernel (rate, traced seed) pair — zero when eval/deterministic."""
+    rate = float(kwargs.get("dropout_rate", 0.0))
+    if not rate or kwargs.get("deterministic", True):
+        return 0.0, None
+    rng = kwargs.get("dropout_rng")
+    if rng is None:
+        raise ValueError(
+            "dropout_rate > 0 with deterministic=False requires a "
+            "dropout_rng (flax passes it when the module is given a "
+            "'dropout' rng collection)"
+        )
+    return rate, jax.random.bits(rng, (), jnp.uint32)
 
 
 def ring_attention_fn(
@@ -607,6 +688,10 @@ def ring_attention_fn(
     kernel — set them to divisors of the local sequence shard when it is
     smaller than 128.
 
+    Attention dropout (``dropout_rate > 0`` on the flax module, training
+    mode) runs in-kernel on the flash path, seeded from the module's
+    dropout rng (requires ``use_flash=True``).
+
     ``module.init`` works outside the ``shard_map`` too: with no bound
     ``sp`` axis the ring degrades to exact single-device attention (the
     n=1 ring), so parameters initialize without a dense twin.
@@ -626,10 +711,11 @@ def ring_attention_fn(
                 "ring_attention_fn derives masking from ring position; "
                 "pass causal=True instead of an explicit mask/bias"
             )
+        rate, seed = _adapter_dropout(kwargs)
         return ring_attention(
             query, key, value, axis_name=axis_name, causal=causal,
             use_flash=use_flash, block_q=block_q, block_k=block_k,
-            window=window,
+            window=window, dropout_rate=rate, dropout_seed=seed,
         )
 
     return fn
@@ -646,6 +732,7 @@ def make_ring_attention(
     block_q: int | None = None,
     block_k: int | None = None,
     window: int | None = None,
+    dropout_rate: float = 0.0,
 ):
     """Wrap :func:`ring_attention` for eager use on mesh-sharded arrays.
 
@@ -684,34 +771,57 @@ def make_ring_attention(
     dp = batch_axis_name
     spec = P(dp, sp)
 
+    if dropout_rate and not use_flash:
+        raise ValueError(
+            "make_ring_attention dropout requires use_flash=True"
+        )
+
     if schedule == "zigzag":
-        def body(q, k, v, *seg):
+        def body(q, k, v, seed, *seg):
             return zigzag_ring_attention(
                 q, k, v, axis_name=sp, use_flash=use_flash,
                 segment_ids=seg if seg else None,
                 block_q=block_q, block_k=block_k,
+                dropout_rate=dropout_rate, dropout_seed=seed,
             )
     else:
-        def body(q, k, v, *seg):
+        def body(q, k, v, seed, *seg):
             return ring_attention(
                 q, k, v, axis_name=sp, causal=causal, use_flash=use_flash,
                 segment_ids=seg if seg else None,
                 block_q=block_q, block_k=block_k, window=window,
+                dropout_rate=dropout_rate, dropout_seed=seed,
             )
+
+    def body_noseed(q, k, v, *seg):
+        return body(q, k, v, None, *seg)
 
     jitted_by_nseg: dict = {}
 
     def _jitted(n_seg: int):
         # One shard_map per arity: segment operands are extra sharded
-        # inputs, so the mapped signature differs with/without them.
+        # inputs, so the mapped signature differs with/without them; the
+        # dropout seed (replicated scalar) is a fourth operand only when
+        # the wrapper was built with dropout_rate > 0.
         if n_seg not in jitted_by_nseg:
-            specs = (spec,) * (3 + n_seg)
-            jitted_by_nseg[n_seg] = jax.jit(shard_map_unchecked(
-                body, mesh, in_specs=specs, out_specs=spec
-            ))
+            if dropout_rate:
+                specs = (spec, spec, spec, P()) + (spec,) * n_seg
+                jitted_by_nseg[n_seg] = jax.jit(shard_map_unchecked(
+                    body, mesh, in_specs=specs, out_specs=spec
+                ))
+            else:
+                specs = (spec,) * (3 + n_seg)
+                jitted_by_nseg[n_seg] = jax.jit(shard_map_unchecked(
+                    body_noseed, mesh, in_specs=specs, out_specs=spec
+                ))
         return jitted_by_nseg[n_seg]
 
-    def fn(q, k, v, segment_ids=None):
+    def fn(q, k, v, segment_ids=None, dropout_seed=None):
+        if dropout_rate and dropout_seed is None:
+            raise ValueError(
+                "this wrapper was built with dropout_rate > 0; pass "
+                "dropout_seed= per call (vary it per step)"
+            )
         size = mesh.shape[sp]
         divisor = 2 * size if schedule == "zigzag" else size
         for name_, t in (("q", q), ("k", k), ("v", v)):
@@ -738,14 +848,19 @@ def make_ring_attention(
                     f"{(ref.shape[0], ref.shape[1])}"
                 )
         sharding = NamedSharding(mesh, spec)
+        seed_args = (
+            (jnp.asarray(dropout_seed, jnp.uint32),) if dropout_rate else ()
+        )
         if schedule == "zigzag":
             idxs = zigzag_indices(q.shape[1], size)
             inv = np.argsort(idxs)
             q, k, v = (jnp.asarray(t)[:, idxs] for t in (q, k, v))
             segs = tuple(s[:, idxs] for s in segs)
-            args = [jax.device_put(t, sharding) for t in (q, k, v, *segs)]
-            return _jitted(len(segs))(*args)[:, inv]
-        args = [jax.device_put(t, sharding) for t in (q, k, v, *segs)]
-        return _jitted(len(segs))(*args)
+            args = [jax.device_put(t, sharding) for t in (q, k, v)]
+            args += [jax.device_put(t, sharding) for t in segs]
+            return _jitted(len(segs))(*args[:3], *seed_args, *args[3:])[:, inv]
+        args = [jax.device_put(t, sharding) for t in (q, k, v)]
+        args += [jax.device_put(t, sharding) for t in segs]
+        return _jitted(len(segs))(*args[:3], *seed_args, *args[3:])
 
     return fn

@@ -33,7 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import config
 from ._compat import shard_map_unchecked
-from .ring import _local_attend
+from .ring import _adapter_dropout, _fold_seed, _local_attend
 
 __all__ = ["ulysses_attention", "make_ulysses_attention", "ulysses_attention_fn"]
 
@@ -50,6 +50,8 @@ def ulysses_attention(
     block_q: int | None = None,
     block_k: int | None = None,
     window: int | None = None,
+    dropout_rate: float = 0.0,
+    dropout_seed=None,
 ) -> jnp.ndarray:
     """All-to-all sequence-parallel attention; call inside ``shard_map``
     with the sequence dimension (axis 1) of q/k/v sharded over
@@ -79,6 +81,16 @@ def ulysses_attention(
     name = axis_name or config.SP_AXIS_NAME
     if window is not None and not causal:
         raise ValueError("window (sliding-window attention) requires causal=True")
+    if dropout_rate and not use_flash:
+        raise ValueError(
+            "ulysses_attention dropout requires use_flash=True (in-kernel "
+            "position-hash masks)"
+        )
+    if dropout_rate and dropout_seed is None:
+        raise ValueError(
+            "dropout_rate > 0 requires dropout_seed (an int or traced "
+            "uint32 scalar)"
+        )
     try:
         n = jax.lax.axis_size(name)
     except NameError:
@@ -86,6 +98,7 @@ def ulysses_attention(
             q, k, v, causal=causal, segment_ids=segment_ids,
             use_flash=use_flash, block_q=block_q, block_k=block_k,
             window=window,
+            dropout_rate=dropout_rate, dropout_seed=dropout_seed,
         )
     b, s_local, h, d = q.shape
     h_kv = k.shape[2]
@@ -136,10 +149,17 @@ def ulysses_attention(
         )
         seg_full = (qseg_f, kseg_f)
 
+    # Fold the device index into the seed: each device holds a different
+    # global head group but the same local (bh, q, k) coordinates.
+    seed = (
+        _fold_seed(dropout_seed, jax.lax.axis_index(name))
+        if dropout_rate else None
+    )
     out = _local_attend(
         qg, kg, vg, causal=causal, segment_ids=seg_full,
         use_flash=use_flash, block_q=block_q, block_k=block_k,
         window=window,
+        dropout_rate=dropout_rate, dropout_seed=seed,
     )
     return heads_to_seq(out)
 
@@ -162,10 +182,11 @@ def ulysses_attention_fn(
                 "ulysses_attention_fn derives masking from causal/"
                 "segment_ids; pass causal=True instead of a mask/bias"
             )
+        rate, seed = _adapter_dropout(kwargs)
         return ulysses_attention(
             query, key, value, axis_name=axis_name, causal=causal,
             use_flash=use_flash, block_q=block_q, block_k=block_k,
-            window=window,
+            window=window, dropout_rate=rate, dropout_seed=seed,
         )
 
     return fn
@@ -181,28 +202,43 @@ def make_ulysses_attention(
     block_q: int | None = None,
     block_k: int | None = None,
     window: int | None = None,
+    dropout_rate: float = 0.0,
 ):
     """Eager wrapper over mesh-sharded arrays (mirror of
-    :func:`fluxmpi_tpu.parallel.ring.make_ring_attention`)."""
+    :func:`fluxmpi_tpu.parallel.ring.make_ring_attention`). With
+    ``dropout_rate > 0`` (requires ``use_flash=True``), pass
+    ``dropout_seed=`` on each call."""
     from ..runtime import global_mesh
 
     mesh = mesh or global_mesh()
     sp = axis_name or config.SP_AXIS_NAME
     dp = batch_axis_name
     spec = P(dp, sp)
+    if dropout_rate and not use_flash:
+        raise ValueError(
+            "make_ulysses_attention dropout requires use_flash=True"
+        )
 
-    def body(q, k, v):
+    def body(q, k, v, *seed):
         return ulysses_attention(
             q, k, v, axis_name=sp, causal=causal, use_flash=use_flash,
             block_q=block_q, block_k=block_k, window=window,
+            dropout_rate=dropout_rate,
+            dropout_seed=seed[0] if seed else None,
         )
 
+    in_specs = (spec, spec, spec) + ((P(),) if dropout_rate else ())
     mapped = shard_map_unchecked(
-        body, mesh, in_specs=(spec, spec, spec), out_specs=spec
+        body, mesh, in_specs=in_specs, out_specs=spec
     )
     jitted = jax.jit(mapped)
 
-    def fn(q, k, v):
+    def fn(q, k, v, dropout_seed=None):
+        if dropout_rate and dropout_seed is None:
+            raise ValueError(
+                "this wrapper was built with dropout_rate > 0; pass "
+                "dropout_seed= per call (vary it per step)"
+            )
         size = mesh.shape[sp]
         for name_, t in (("q", q), ("k", k), ("v", v)):
             if t.shape[1] % size != 0:
@@ -217,6 +253,8 @@ def make_ulysses_attention(
                 )
         sharding = NamedSharding(mesh, spec)
         q, k, v = (jax.device_put(t, sharding) for t in (q, k, v))
+        if dropout_rate:
+            return jitted(q, k, v, jnp.asarray(dropout_seed, jnp.uint32))
         return jitted(q, k, v)
 
     return fn
