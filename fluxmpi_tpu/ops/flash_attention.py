@@ -47,11 +47,13 @@ _NEG_INF = -1e30
 
 
 # TPU VMEM tiling wants the last two dims of every block to be (8·k, 128·k)
-# or the full array dim. 1-D per-row operands (lse, dterm, segment ids)
-# therefore travel lane-replicated ([.., s, 128], read as a [block, 1]
-# column) or sublane-replicated ([.., 8, s], read as a [1, block] row),
-# matching the orientation each kernel consumes them in — no in-kernel
-# relayouts.
+# or the full array dim. 1-D per-row operands therefore travel
+# sublane-replicated ([.., 8, s], read as a [1, block] row — lse/dterm
+# everywhere, at 8× HBM) or lane-replicated ([.., s, 128], read as a
+# [block, 1] column — the per-batch segment q-ids in the fwd/dq kernels),
+# matching the orientation each kernel consumes them in; the dq kernel's
+# lse/dterm reads pay one in-register row→column transpose per tile
+# instead of a 128× lane-replicated buffer (ADVICE r3 #2).
 _LANES = 128
 _SUBLANES = 8
 
