@@ -502,7 +502,9 @@ def _bench_transformer():
     on_tpu = jax.default_backend() == "tpu"
     vocab, seq = 32768, 1024
     if on_tpu:
-        n_layers, d_model, n_heads, d_ff, per_chip = 8, 1024, 16, 4096, 8
+        n_layers, d_model, n_heads, d_ff = 8, 1024, 16, 4096
+        # Per-chip batch sweep knob (mirror of FLUXMPI_TPU_RESNET_BATCH).
+        per_chip = int(os.environ.get("FLUXMPI_TPU_LM_BATCH", "8"))
     else:  # CPU smoke configuration
         n_layers, d_model, n_heads, d_ff, per_chip = 2, 128, 4, 256, 2
 
