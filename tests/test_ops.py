@@ -755,6 +755,7 @@ def test_flash_property_sweep(world, seed):
     block = int(rng.choice([8, 16]))
     dtype = jnp.bfloat16 if rng.integers(0, 2) else jnp.float32
     atol = 0.06 if dtype == jnp.bfloat16 else 3e-5
+    drop = float(rng.choice([0.0, 0.3]))
 
     q = jnp.asarray(rng.normal(size=(b, sq, h, d)).astype(np.float32)).astype(dtype)
     k = jnp.asarray(rng.normal(size=(b, sq, h_kv, d)).astype(np.float32)).astype(dtype)
@@ -776,6 +777,7 @@ def test_flash_property_sweep(world, seed):
     out = flash_attention(
         q, k, v, causal=causal, window=window, segment_ids=seg,
         block_q=block, block_k=block,
+        dropout_rate=drop, dropout_seed=seed if drop else None,
     )
 
     # Dense oracle with identical semantics (f32 math; bf16 inputs upcast).
@@ -796,13 +798,26 @@ def test_flash_property_sweep(world, seed):
         mask = mask & sm[:, None]
     s = jnp.where(jnp.asarray(mask), s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
+    if drop:
+        from fluxmpi_tpu.ops.flash_attention import _dropout_keep
+
+        p = jnp.where(jnp.asarray(mask), p, 0.0)
+        q_pos = jnp.broadcast_to(jnp.arange(sq)[:, None], (sq, sq))
+        k_pos = jnp.broadcast_to(jnp.arange(sq)[None, :], (sq, sq))
+        keep = jax.vmap(
+            lambda bh: _dropout_keep(
+                jnp.uint32(seed), bh, q_pos, k_pos, 1.0 - drop
+            )
+        )(jnp.arange(b * h, dtype=jnp.uint32)).reshape(b, h, sq, sq)
+        p = jnp.where(keep, p / (1.0 - drop), 0.0)
     expected = jnp.einsum("bhqk,bkhd->bqhd", p, vf)
 
     np.testing.assert_allclose(
         np.asarray(out, dtype=np.float32)[valid],
         np.asarray(expected)[valid], atol=atol,
         err_msg=f"config: b={b} sq={sq} h={h} h_kv={h_kv} causal={causal} "
-                f"window={window} seg={use_seg} block={block} dtype={dtype}",
+                f"window={window} seg={use_seg} block={block} dtype={dtype} "
+                f"drop={drop}",
     )
 
 
