@@ -263,6 +263,14 @@ class DistributedDataLoader:
             return self._common_len // self.local_batch_size
         return math.ceil(self._common_len / self.local_batch_size)
 
+    def set_epoch(self, epoch: int) -> None:
+        """Pin the epoch counter that keys the per-epoch shuffle (and the
+        global-shuffle worker assignment). Call after restoring a
+        checkpoint so a resumed run draws the same sample order the
+        uninterrupted run would have — the loader's counter is plain
+        Python state and is NOT part of the checkpointed TrainState."""
+        self._epoch = int(epoch)
+
     def _sharding(self) -> NamedSharding:
         mesh = self.mesh or global_mesh()
         return NamedSharding(mesh, P(self.axis_name))
