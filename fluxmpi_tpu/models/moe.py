@@ -25,7 +25,11 @@ onto the MXU:
   trade for static shapes;
 - the load-balancing auxiliary loss (router probs × token fractions) is
   sowed under the ``"losses"`` collection; pull it out with
-  ``mutable=["losses"]`` and add it to the task loss.
+  ``mutable=["losses"]`` and add it to the task loss;
+- a third routing family, expert choice (``routing="experts"``, Zhou et
+  al. 2022), inverts the selection: each expert takes its top-capacity
+  tokens — perfect load balance by construction (the sowed aux loss is a
+  structural 0), no overflow drops, same parameter tree and ep pins.
 """
 
 from __future__ import annotations
@@ -75,6 +79,15 @@ class MoEMLP(nn.Module):
     # gates, first choices claim capacity first). Capacity scales with
     # top_k: ceil(group_size · capacity_factor · top_k / E).
     top_k: int = 1
+    # Routing family: "tokens" (tokens pick top-k experts — Switch/GShard,
+    # above) or "experts" (expert-choice routing: each expert picks its
+    # top-capacity tokens by router score — perfect load balance by
+    # construction, no overflow drops, no aux loss needed; tokens no
+    # expert picks pass through on the residual. Caveat: an expert's
+    # top-C spans the whole group INCLUDING future positions, so
+    # expert-choice is for encoders/non-autoregressive training, not
+    # causal LM inference).
+    routing: str = "tokens"
     # Expert-parallel lowering pin: with a mesh, the expert-major
     # activations are sharding-constrained to (group→dp, expert→ep), which
     # forces XLA's partitioner to MOVE THE TOKENS (all-to-all over the ep
@@ -174,6 +187,19 @@ class MoEMLP(nn.Module):
             )
         probs = jax.nn.softmax(logits, axis=-1)  # [G, S, E]
 
+        if self.routing not in ("tokens", "experts"):
+            raise ValueError(
+                f"routing={self.routing!r} must be 'tokens' or 'experts'"
+            )
+        if self.routing == "experts":
+            if self.top_k != 1:
+                raise ValueError(
+                    "expert-choice routing has no top_k (capacity_factor "
+                    "sets each expert's token budget); leave top_k=1"
+                )
+            return self._expert_choice(x, lead, tokens, probs, groups, gs,
+                                       d_model)
+
         if not 1 <= self.top_k <= self.num_experts:
             raise ValueError(
                 f"top_k={self.top_k} must be in [1, num_experts="
@@ -231,6 +257,26 @@ class MoEMLP(nn.Module):
         )
         self.sow("losses", "moe_aux_loss", aux_loss)
 
+        # Group axis follows the token batch sharding only under default
+        # grouping (one group per batch row); explicit n_groups has no
+        # fixed relation to the mesh.
+        g_dim = "dp" if (self.n_groups is None and len(lead) >= 2) else None
+
+        expert_in = jnp.einsum(
+            "gsec,gsd->gecd", dispatch.astype(self.dtype), tokens
+        )  # [G, E, C, d_model]
+        out = self._apply_experts(expert_in, g_dim, d_model)
+        y = jnp.einsum("gsec,gecd->gsd", combine.astype(self.dtype), out)
+        # …and all-to-all back to the batch layout.
+        y = self._pin(y, ("dp", "ep") if g_dim else None, None, None)
+        return y.reshape(*lead, d_model).astype(x.dtype)
+
+    def _apply_experts(self, expert_in, g_dim, d_model):
+        """Create the expert weights and run the per-expert FFN on
+        expert-major activations ``[G, E, C, d_model]``, with the
+        (dp×ep → ep-sharded) pins at the all-to-all boundary. Shared by
+        both routing families — parameter names/order are identical, so
+        checkpoints trained with one routing load under the other."""
         w1 = self.param(
             "w1",
             nn.initializers.lecun_normal(),
@@ -244,14 +290,6 @@ class MoEMLP(nn.Module):
         )
         b2 = self.param("b2", nn.initializers.zeros, (self.num_experts, d_model))
 
-        # Group axis follows the token batch sharding only under default
-        # grouping (one group per batch row); explicit n_groups has no
-        # fixed relation to the mesh.
-        g_dim = "dp" if (self.n_groups is None and len(lead) >= 2) else None
-
-        expert_in = jnp.einsum(
-            "gsec,gsd->gecd", dispatch.astype(self.dtype), tokens
-        )  # [G, E, C, d_model]
         # The all-to-all boundary: tokens leave the (dp×ep)-sharded batch
         # layout and land expert-sharded for the FFN…
         expert_in = self._pin(expert_in, g_dim, "ep", None, None)
@@ -260,9 +298,36 @@ class MoEMLP(nn.Module):
         h = self._pin(h, g_dim, "ep", None, None)
         out = jnp.einsum("gecf,efd->gecd", h, w2.astype(self.dtype))
         out = out + b2[None, :, None, :].astype(self.dtype)
-        out = self._pin(out, g_dim, "ep", None, None)
-        y = jnp.einsum("gsec,gecd->gsd", combine.astype(self.dtype), out)
-        # …and all-to-all back to the batch layout.
+        return self._pin(out, g_dim, "ep", None, None)
+
+    def _expert_choice(self, x, lead, tokens, probs, groups, gs, d_model):
+        """Expert-choice routing (Zhou et al. 2022): each expert takes its
+        top-``capacity`` tokens by router probability — every expert is
+        exactly full (perfect load balance structurally; the aux loss is
+        sowed as 0 so the ``"losses"`` collection stays uniform), tokens
+        can be refined by 0..E experts, and unpicked tokens ride the
+        residual. Same dense one-hot dispatch/einsum formulation and the
+        same ep pins as the token-choice path."""
+        capacity = min(
+            gs,
+            max(1, int(-(-gs * self.capacity_factor // self.num_experts))),
+        )
+        scores = jnp.transpose(probs, (0, 2, 1))  # [G, E, S]
+        gates, idx = jax.lax.top_k(scores, capacity)  # [G, E, C]
+        onehot = jax.nn.one_hot(idx, gs, dtype=jnp.float32)  # [G, E, C, S]
+        self.sow("losses", "moe_aux_loss", jnp.zeros((), jnp.float32))
+
+        g_dim = "dp" if (self.n_groups is None and len(lead) >= 2) else None
+        expert_in = jnp.einsum(
+            "gecs,gsd->gecd", onehot.astype(self.dtype), tokens
+        )  # [G, E, C, d_model]
+        out = self._apply_experts(expert_in, g_dim, d_model)
+        y = jnp.einsum(
+            "gecs,gec,gecd->gsd",
+            onehot.astype(self.dtype),
+            gates.astype(self.dtype),
+            out,
+        )
         y = self._pin(y, ("dp", "ep") if g_dim else None, None, None)
         return y.reshape(*lead, d_model).astype(x.dtype)
 
@@ -279,6 +344,7 @@ class MoEEncoderBlock(EncoderBlock):
     ep_axis: str | None = None
     dp_axis: str | None = None
     top_k: int = 1
+    routing: str = "tokens"
 
     def make_ff(self) -> nn.Module:
         return MoEMLP(
@@ -288,6 +354,7 @@ class MoEEncoderBlock(EncoderBlock):
             dtype=self.dtype,
             n_groups=self.n_groups,
             top_k=self.top_k,
+            routing=self.routing,
             mesh=self.mesh,
             ep_axis=self.ep_axis,
             dp_axis=self.dp_axis,
@@ -305,6 +372,7 @@ class MoEEncoder(TransformerEncoder):
     ep_axis: str | None = None
     dp_axis: str | None = None
     top_k: int = 1
+    routing: str = "tokens"
 
     def make_block(self, i: int) -> nn.Module:
         return MoEEncoderBlock(
@@ -318,6 +386,7 @@ class MoEEncoder(TransformerEncoder):
             capacity_factor=self.capacity_factor,
             n_groups=self.n_groups,
             top_k=self.top_k,
+            routing=self.routing,
             mesh=self.mesh,
             ep_axis=self.ep_axis,
             dp_axis=self.dp_axis,
@@ -338,8 +407,23 @@ class MoETransformerLM(TransformerLM):
     ep_axis: str | None = None
     dp_axis: str | None = None
     top_k: int = 1
+    routing: str = "tokens"
 
     def make_encoder(self) -> nn.Module:
+        if self.routing == "experts":
+            # Expert-choice selection spans the whole group INCLUDING
+            # future positions: during causal-LM training position s's
+            # routing depends on future tokens (leakage), and at
+            # autoregressive inference the routing context differs. Loud
+            # once; legitimate for masked/prefix-LM-style uses.
+            warnings.warn(
+                "MoETransformerLM with routing='experts': expert-choice "
+                "routing is not causal (an expert's top-capacity token "
+                "selection sees future positions) — next-token training "
+                "losses are optimistic and autoregressive decoding routes "
+                "differently. Intended for non-autoregressive objectives.",
+                stacklevel=2,
+            )
         return MoEEncoder(
             num_layers=self.num_layers,
             d_model=self.d_model,
@@ -352,6 +436,7 @@ class MoETransformerLM(TransformerLM):
             capacity_factor=self.capacity_factor,
             n_groups=self.n_groups,
             top_k=self.top_k,
+            routing=self.routing,
             mesh=self.mesh,
             ep_axis=self.ep_axis,
             dp_axis=self.dp_axis,
