@@ -123,7 +123,8 @@ def _check_layout(path: str, expected: str) -> None:
             f"restore template is {expected}: restoring a sharded (FSDP/TP) "
             "checkpoint needs a `like` tree carrying the training shardings "
             "(and vice versa) — re-shard the template with shard_tree, or "
-            "re-save in the target layout"
+            "pass allow_layout_change=True to cross layout families "
+            "deliberately"
         )
 
 
@@ -178,7 +179,13 @@ def save_checkpoint(path: str, state: Any, *, force: bool = True) -> None:
     _process_barrier(f"ckpt_save:{path}")
 
 
-def restore_checkpoint(path: str, like: Any, *, root_rank: int = 0) -> Any:
+def restore_checkpoint(
+    path: str,
+    like: Any,
+    *,
+    root_rank: int = 0,
+    allow_layout_change: bool = False,
+) -> Any:
     """Read the checkpoint at ``path`` and return it synchronized from
     ``root_rank`` and laid out like ``like`` (replicated over the mesh).
 
@@ -188,17 +195,32 @@ def restore_checkpoint(path: str, like: Any, *, root_rank: int = 0) -> Any:
     (FSDP/TP) instead restores collectively, each leaf landing directly in
     its training sharding — no host gather, no broadcast needed (the
     checkpoint bytes are the single source, so root_rank is moot).
+
+    Elastic restore: a sharded checkpoint restores onto a DIFFERENT mesh
+    topology whenever ``like`` carries the target shardings (orbax
+    reshards on read) — e.g. resume a pod run on a smaller slice. Crossing
+    the replicated↔sharded *layout family* (e.g. inspecting a pod FSDP
+    checkpoint fully replicated on one host) is usually an accident, so
+    the layout marker rejects it unless ``allow_layout_change=True``.
     """
     path = os.path.abspath(path)
     if _is_sharded_tree(like):
-        _check_layout(path, "sharded")
+        if not allow_layout_change:
+            _check_layout(path, "sharded")
         return _restore_sharded(path, like)
-    _check_layout(path, "replicated")
+    if not allow_layout_change:
+        _check_layout(path, "replicated")
     # The restore template only needs structure/shape/dtype — avoid pulling
     # the whole live state to host just to describe it.
     try:
+        # Carry the TEMPLATE's sharding so orbax reshards to the target
+        # layout deterministically instead of consulting the checkpoint's
+        # saved sharding file — which references the SAVE topology's
+        # devices and is unsafe to apply on a different one (the elastic
+        # cross-family path).
         template = jax.tree_util.tree_map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=x.sharding)
             if isinstance(x, jax.Array)
             else x,
             like,
@@ -365,10 +387,17 @@ class CheckpointManager:
         if pending is not None:
             _wait_with_diagnostic(pending, "in-flight async checkpoint save")
 
-    def restore(self, like: Any, *, step: int | None = None) -> tuple[int, Any]:
+    def restore(
+        self,
+        like: Any,
+        *,
+        step: int | None = None,
+        allow_layout_change: bool = False,
+    ) -> tuple[int, Any]:
         """Restore ``step`` (default: latest complete) as
         ``(step, state)``; raises ``FileNotFoundError`` when nothing is
-        restorable."""
+        restorable. ``allow_layout_change`` forwards to
+        :func:`restore_checkpoint` (elastic cross-family restore)."""
         self.wait_until_finished()
         if step is None:
             step = self.latest_step()
@@ -376,7 +405,10 @@ class CheckpointManager:
                 raise FileNotFoundError(
                     f"no complete checkpoint under {self.directory}"
                 )
-        return step, restore_checkpoint(self._step_path(step), like)
+        return step, restore_checkpoint(
+            self._step_path(step), like,
+            allow_layout_change=allow_layout_change,
+        )
 
     def close(self) -> None:
         self.wait_until_finished()

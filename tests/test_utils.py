@@ -286,3 +286,55 @@ def test_checkpoint_manager_async_survives_donation(world, tmp_path):
             ),
             jax.device_get(restored.params), jax.device_get(state.params),
         )
+
+
+def test_checkpoint_elastic_cross_topology_restore(world, tmp_path):
+    # Elastic resume: a sharded (FSDP) checkpoint saved on one mesh shape
+    # restores onto a DIFFERENT topology — smaller mesh, and fully
+    # replicated — with exact values; orbax reshards to the template's
+    # shardings. (The reference has no checkpoint subsystem at all —
+    # SURVEY.md §5; this is the capability its synchronize-based
+    # load-on-root pattern cannot express for sharded state.)
+    import optax
+    from jax.sharding import Mesh
+
+    from fluxmpi_tpu.parallel import TrainState, fsdp_rule, shard_tree
+    from fluxmpi_tpu.parallel.train import replicate
+    from fluxmpi_tpu.utils import restore_checkpoint, save_checkpoint
+
+    mesh8 = Mesh(np.asarray(jax.devices()[:8]).reshape(8), ("dp",))
+    params = {"w": jnp.arange(64, dtype=jnp.float32).reshape(16, 4)}
+    opt = optax.adam(1e-3)
+    state8, _ = shard_tree(
+        TrainState.create(params, opt), mesh8, fsdp_rule(mesh8, min_size=8)
+    )
+    assert not state8.params["w"].is_fully_replicated
+    path = str(tmp_path / "elastic")
+    save_checkpoint(path, state8)
+
+    host = jax.device_get(state8)
+    zeros = jax.tree_util.tree_map(
+        lambda x: jnp.zeros_like(x) if isinstance(x, jax.Array) else x, host
+    )
+
+    # Smaller mesh, still FSDP-sharded.
+    mesh4 = Mesh(np.asarray(jax.devices()[:4]).reshape(4), ("dp",))
+    tmpl4, _ = shard_tree(zeros, mesh4, fsdp_rule(mesh4, min_size=8))
+    r4 = restore_checkpoint(path, tmpl4)
+    assert len(r4.params["w"].sharding.device_set) == 4
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(r4.params["w"])), np.asarray(params["w"])
+    )
+
+    # Fully replicated target (e.g. debugging a pod checkpoint on one
+    # host).
+    mesh2 = Mesh(np.asarray(jax.devices()[:2]).reshape(2), ("dp",))
+    with pytest.raises(ValueError, match="layout"):
+        restore_checkpoint(path, replicate(zeros, mesh2))
+    r_rep = restore_checkpoint(
+        path, replicate(zeros, mesh2), allow_layout_change=True
+    )
+    assert r_rep.params["w"].is_fully_replicated
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(r_rep.params["w"])), np.asarray(params["w"])
+    )
