@@ -103,8 +103,10 @@ def moe_loss(p, mstate, b):
     x, y = b
     logits, mut = moe.apply(p, x, train=True, mutable=["losses"])
     task = jnp.mean(optax.softmax_cross_entropy_with_integer_labels(logits, y))
-    aux = sum(jax.tree_util.tree_leaves(mut["losses"]))
-    return task + 0.01 * aux, mstate
+    from fluxmpi_tpu.models import collect_moe_losses
+
+    aux, zl = collect_moe_losses(mut["losses"])
+    return task + 0.01 * aux + 1e-3 * zl, mstate
 
 
 step_ep = make_train_step(

@@ -18,6 +18,7 @@ from .moe import (  # noqa: F401
     MoEEncoderBlock,
     MoEMLP,
     MoETransformerLM,
+    collect_moe_losses,
     expert_parallel_rules,
 )
 from .resnet import (  # noqa: F401

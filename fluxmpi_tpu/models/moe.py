@@ -24,8 +24,11 @@ onto the MXU:
   residual connection carries them through unchanged) — the standard Switch
   trade for static shapes;
 - the load-balancing auxiliary loss (router probs × token fractions) is
-  sowed under the ``"losses"`` collection; pull it out with
-  ``mutable=["losses"]`` and add it to the task loss;
+  sowed under ``"losses"/"moe_aux_loss"`` and the ST-MoE router z-loss
+  (mean squared logsumexp of router logits) under
+  ``"losses"/"moe_router_z_loss"``; pull them out with
+  ``mutable=["losses"]`` and add each with its OWN coefficient (typical:
+  1e-2 for balance, 1e-3 for z) — don't blindly sum all leaves;
 - a third routing family, expert choice (``routing="experts"``, Zhou et
   al. 2022), inverts the selection: each expert takes its top-capacity
   tokens — perfect load balance by construction (the sowed aux loss is a
@@ -53,8 +56,26 @@ __all__ = [
     "MoEEncoderBlock",
     "MoEEncoder",
     "MoETransformerLM",
+    "collect_moe_losses",
     "expert_parallel_rules",
 ]
+
+
+def collect_moe_losses(losses_collection: Any) -> tuple[Any, Any]:
+    """Sum the sowed MoE losses across every layer of a (possibly nested)
+    ``"losses"`` collection: returns ``(balance_loss, router_z_loss)``.
+    Add each to the task loss with its OWN coefficient (typical: 1e-2 for
+    balance, 1e-3 for z)."""
+    flat = jax.tree_util.tree_flatten_with_path(losses_collection)[0]
+    aux = 0.0
+    z = 0.0
+    for path, leaf in flat:
+        keys = jax.tree_util.keystr(path)
+        if "moe_aux_loss" in keys:
+            aux = aux + leaf
+        elif "moe_router_z_loss" in keys:
+            z = z + leaf
+    return aux, z
 
 
 class MoEMLP(nn.Module):
@@ -197,8 +218,8 @@ class MoEMLP(nn.Module):
                     "expert-choice routing has no top_k (capacity_factor "
                     "sets each expert's token budget); leave top_k=1"
                 )
-            return self._expert_choice(x, lead, tokens, probs, groups, gs,
-                                       d_model)
+            return self._expert_choice(x, lead, tokens, probs, logits,
+                                       groups, gs, d_model)
 
         if not 1 <= self.top_k <= self.num_experts:
             raise ValueError(
@@ -256,6 +277,7 @@ class MoEMLP(nn.Module):
             jnp.sum(frac_tokens * frac_probs, axis=-1)
         )
         self.sow("losses", "moe_aux_loss", aux_loss)
+        self.sow("losses", "moe_router_z_loss", self._z_loss(logits))
 
         # Group axis follows the token batch sharding only under default
         # grouping (one group per batch row); explicit n_groups has no
@@ -270,6 +292,16 @@ class MoEMLP(nn.Module):
         # …and all-to-all back to the batch layout.
         y = self._pin(y, ("dp", "ep") if g_dim else None, None, None)
         return y.reshape(*lead, d_model).astype(x.dtype)
+
+    @staticmethod
+    def _z_loss(logits):
+        """ST-MoE router z-loss: mean squared logsumexp of the router
+        logits — penalizes drifting logit magnitudes (the router's f32
+        softmax saturates and gradients vanish when logits blow up).
+        Sowed under ``"losses"`` like the balance loss; scale it with its
+        own small coefficient (ST-MoE uses 1e-3) when adding to the task
+        loss."""
+        return jnp.mean(jax.scipy.special.logsumexp(logits, axis=-1) ** 2)
 
     def _apply_experts(self, expert_in, g_dim, d_model):
         """Create the expert weights and run the per-expert FFN on
@@ -300,7 +332,8 @@ class MoEMLP(nn.Module):
         out = out + b2[None, :, None, :].astype(self.dtype)
         return self._pin(out, g_dim, "ep", None, None)
 
-    def _expert_choice(self, x, lead, tokens, probs, groups, gs, d_model):
+    def _expert_choice(self, x, lead, tokens, probs, logits, groups, gs,
+                       d_model):
         """Expert-choice routing (Zhou et al. 2022): each expert takes its
         top-``capacity`` tokens by router probability — every expert is
         exactly full (perfect load balance structurally; the aux loss is
@@ -316,6 +349,11 @@ class MoEMLP(nn.Module):
         gates, idx = jax.lax.top_k(scores, capacity)  # [G, E, C]
         onehot = jax.nn.one_hot(idx, gs, dtype=jnp.float32)  # [G, E, C, S]
         self.sow("losses", "moe_aux_loss", jnp.zeros((), jnp.float32))
+        # z-loss still applies under expert choice (it stabilizes the
+        # router softmax magnitudes, independent of the selection family)
+        # — computed on the RAW logits: on softmaxed probs it would be
+        # log(1) = 0 identically (review-caught).
+        self.sow("losses", "moe_router_z_loss", self._z_loss(logits))
 
         g_dim = "dp" if (self.n_groups is None and len(lead) >= 2) else None
         expert_in = jnp.einsum(

@@ -139,16 +139,23 @@ def _save_sharded(path: str, state: Any, force: bool) -> None:
     ckptr.wait_until_finished()
 
 
-def _restore_sharded(path: str, like: Any) -> Any:
-    import orbax.checkpoint as ocp
-
-    template = jax.tree_util.tree_map(
+def _sds_template(like: Any) -> Any:
+    """Restore template carrying the TARGET's sharding on every jax leaf —
+    orbax then reshards to it deterministically instead of consulting the
+    checkpoint's saved sharding file (which references the SAVE topology's
+    devices and is unsafe to apply on a different one)."""
+    return jax.tree_util.tree_map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
         if isinstance(x, jax.Array)
         else x,
         like,
     )
-    return ocp.StandardCheckpointer().restore(path, template)
+
+
+def _restore_sharded(path: str, like: Any) -> Any:
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer().restore(path, _sds_template(like))
 
 
 def save_checkpoint(path: str, state: Any, *, force: bool = True) -> None:
@@ -213,23 +220,21 @@ def restore_checkpoint(
     # The restore template only needs structure/shape/dtype — avoid pulling
     # the whole live state to host just to describe it.
     try:
-        # Carry the TEMPLATE's sharding so orbax reshards to the target
-        # layout deterministically instead of consulting the checkpoint's
-        # saved sharding file — which references the SAVE topology's
-        # devices and is unsafe to apply on a different one (the elastic
-        # cross-family path).
-        template = jax.tree_util.tree_map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
-                                           sharding=x.sharding)
-            if isinstance(x, jax.Array)
-            else x,
-            like,
-        )
-        restored = _checkpointer().restore(path, item=template)
-    except (TypeError, ValueError):
+        restored = _checkpointer().restore(path, item=_sds_template(like))
+    except (TypeError, ValueError) as exc:
+        if allow_layout_change:
+            # The sharding-carrying template IS the safety mechanism of the
+            # cross-family elastic restore; a bare host-array fallback would
+            # let orbax consult the checkpoint's saved sharding file (save
+            # topology's devices). Fail loudly instead of degrading.
+            raise RuntimeError(
+                "elastic cross-family restore needs an orbax version that "
+                "accepts sharding-carrying ShapeDtypeStruct templates"
+            ) from exc
         # Older orbax versions reject ShapeDtypeStruct templates; fall back to
-        # a concrete-host-array template. Genuine restore errors (missing or
-        # corrupt checkpoint) raise other exception types and propagate.
+        # a concrete-host-array template (same-topology restores only reach
+        # here). Genuine restore errors (missing or corrupt checkpoint) raise
+        # other exception types and propagate.
         restored = _checkpointer().restore(
             path,
             item=jax.tree_util.tree_map(

@@ -65,9 +65,10 @@ def test_aux_loss_sowed(world):
     x = jnp.asarray(np.random.default_rng(2).normal(size=(2, 8, 8)), jnp.float32)
     params = {"params": model.init(jax.random.PRNGKey(2), x)["params"]}
     _, mutated = model.apply(params, x, mutable=["losses"])
-    (aux,) = jax.tree_util.tree_leaves(mutated["losses"])
+    (aux,) = mutated["losses"]["moe_aux_loss"]
     # Switch aux loss is E * sum_e f_e P_e >= 1 with equality at perfect
-    # balance; must always be a finite positive scalar.
+    # balance; must always be a finite positive scalar. (The z-loss rides
+    # the same collection under its own key.)
     assert aux.shape == ()
     assert float(aux) >= 0.99
 
@@ -111,8 +112,10 @@ def test_expert_parallel_train_step(world):
         task = jnp.mean(
             optax.softmax_cross_entropy_with_integer_labels(logits, by)
         )
-        aux = sum(jax.tree_util.tree_leaves(mutated["losses"]))
-        return task + 0.01 * aux, mstate
+        from fluxmpi_tpu.models import collect_moe_losses
+
+        aux, zl = collect_moe_losses(mutated["losses"])
+        return task + 0.01 * aux + 1e-3 * zl, mstate
 
     step = make_train_step(
         loss_fn,
@@ -213,8 +216,10 @@ def test_ep_moe_lowers_to_all_to_all(world):
         task = jnp.mean(
             optax.softmax_cross_entropy_with_integer_labels(logits, by)
         )
-        aux = sum(jax.tree_util.tree_leaves(mutated["losses"]))
-        return task + 0.01 * aux, mstate
+        from fluxmpi_tpu.models import collect_moe_losses
+
+        aux, zl = collect_moe_losses(mutated["losses"])
+        return task + 0.01 * aux + 1e-3 * zl, mstate
 
     step = make_train_step(
         loss_fn, optimizer, mesh=mesh, state_sharding=shardings,
@@ -513,3 +518,47 @@ def test_expert_choice_checkpoint_compatible_with_token_choice(world):
         )
     with pytest.raises(ValueError, match="routing"):
         MoEMLP(num_experts=2, routing="bogus").init(jax.random.PRNGKey(0), x)
+
+
+def test_router_z_loss_sowed(world):
+    # ST-MoE router z-loss rides the "losses" collection in both routing
+    # families: mean squared logsumexp of router logits, down-weighted by
+    # the caller's own coefficient.
+    from fluxmpi_tpu.models import MoEMLP
+
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(2, 8, 4)).astype(np.float32)
+    )
+    for routing in ("tokens", "experts"):
+        model = MoEMLP(num_experts=2, d_ff=8, routing=routing)
+        # Strip init-time sown values: passing them back makes sow APPEND,
+        # and index [0] would read the init-time constant (zero grad).
+        params = {
+            "params": model.init(jax.random.PRNGKey(0), x)["params"]
+        }
+        _, mutated = model.apply(params, x, mutable=["losses"])
+        z = mutated["losses"]["moe_router_z_loss"][0]
+        # Strictly positive (a structurally-zero z-loss was a caught bug)
+        # and it must reach the router weights with nonzero gradient.
+        assert np.isfinite(float(z)) and float(z) > 1e-6, (routing, float(z))
+
+        def zloss_of(p):
+            _, mut = model.apply(p, x, mutable=["losses"])
+            return mut["losses"]["moe_router_z_loss"][0]
+
+        g = jax.grad(zloss_of)(params)
+        assert float(jnp.abs(g["params"]["router"]).max()) > 0.0, routing
+    # Token-choice value matches the formula from the raw logits.
+    model = MoEMLP(num_experts=2, d_ff=8)
+    params = {"params": model.init(jax.random.PRNGKey(0), x)["params"]}
+    _, mutated = model.apply(params, x, mutable=["losses"])
+    logits = np.asarray(x.reshape(2, 8, 4)) @ np.asarray(
+        params["params"]["router"]
+    )
+    expected = float(np.mean(
+        np.asarray(jax.scipy.special.logsumexp(jnp.asarray(logits), axis=-1))
+        ** 2
+    ))
+    np.testing.assert_allclose(
+        float(mutated["losses"]["moe_router_z_loss"][0]), expected, rtol=1e-5
+    )
