@@ -812,12 +812,14 @@ def _run_scaling(
     n_accel = (accel_probe or {}).get("n_devices", 0)
     if accel_probe and n_accel > 1:
         platform, n, extra = accel_platform, n_accel, {}
-        # Self-label with the real backend ("tpu" on a pod slice) so the
-        # scaling number is never mistaken for the cpu-virtual plumbing
-        # proof.
-        mode = accel_probe.get("platform", "accelerator")
+        # Stable label for external tooling; the real backend ("tpu" on a
+        # pod slice) rides in a separate "backend" key so the scaling
+        # number is never mistaken for the cpu-virtual plumbing proof.
+        mode = "accelerator"
+        backend = accel_probe.get("platform")
     else:
         platform, n = "cpu", 8
+        backend = "cpu"
         # Append (not clobber) — the operator's own XLA_FLAGS survive; for
         # duplicated flags the last occurrence wins in XLA's parser.
         flags = (
@@ -832,7 +834,7 @@ def _run_scaling(
     # short-budget slice runs). See docs/performance.md "Pod-slice
     # scaling runbook".
     cfg = os.environ.get("FLUXMPI_TPU_BENCH_SCALING_CONFIG") or (
-        "resnet50" if mode == "tpu" else "mlp"
+        "resnet50" if backend == "tpu" else "mlp"
     )
     cap = 600.0 if cfg == "resnet50" else 240.0
     per_child = min(cap, (remaining_s - 10) / 2)
@@ -847,6 +849,7 @@ def _run_scaling(
         return None
     return {
         "mode": mode,
+        "backend": backend,
         "config": cfg,
         "n_chips": rn.get("n_chips", n),
         "per_chip_at_dp1": r1["value"],

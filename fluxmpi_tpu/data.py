@@ -172,7 +172,11 @@ class DistributedDataLoader:
         while the DMA is in flight). Depth 2 means the device never waits
         on the input pipeline as long as host assembly keeps up — the
         device-side completion of the C++ host-side prefetcher. 0 disables
-        (each batch transfers on demand).
+        (each batch transfers on demand). Memory note: up to
+        ``prefetch + 1`` global batches are resident/in-flight on device
+        at once — for very large vision batches pass ``prefetch=1`` or
+        ``0`` (see docs/gotchas.md, "Prefetch holds extra batches on
+        device").
     """
 
     def __init__(

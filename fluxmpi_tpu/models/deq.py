@@ -84,24 +84,38 @@ def _anderson_iteration(
     gf, z0f, unflatten = _flatten_batched(g, z0)
     n, d = z0f.shape
 
-    # Seed the history with min(m, max_iter) plain iterations (statically
-    # unrolled) — the documented max_iter budget bounds TOTAL cell
-    # evaluations including seeding. Unfilled slots keep a huge sentinel
-    # residual, so the regularized least squares assigns them ~zero
-    # weight until real iterates overwrite them.
+    # Seed the history with up to min(m, max_iter) plain iterations — the
+    # documented max_iter budget bounds TOTAL cell evaluations including
+    # seeding. The seed loop checks the residual from iteration 1 (like
+    # the damped solver), so an already- or quickly-converged z0 exits
+    # without spending the full m-evaluation seed budget. Unfilled slots
+    # keep a huge sentinel residual, so the regularized least squares
+    # assigns them ~zero weight until real iterates overwrite them.
     m_seed = min(m, int(max_iter))
     Z = jnp.zeros((m, n, d), jnp.float32)  # iterates  z_k
     F = jnp.full((m, n, d), 1e6, jnp.float32)  # residuals g(z_k) - z_k
-    z = z0f
-    for i in range(m_seed):
+
+    def seed_cond(carry):
+        z, res, Z, F, it = carry
+        return jnp.logical_and(it < m_seed, res > tol)
+
+    def seed_body(carry):
+        z, _, Z, F, it = carry
         gz = gf(z)
-        Z = Z.at[i].set(z)
-        F = F.at[i].set(gz - z)
-        z = gz
+        f = gz - z
+        Z = jax.lax.dynamic_update_index_in_dim(Z, z, it, 0)
+        F = jax.lax.dynamic_update_index_in_dim(F, f, it, 0)
+        # Plain iteration: z_new = gz, so the iterate difference
+        # |z_new - z| equals the fixed-point residual |f|.
+        return gz, jnp.max(jnp.abs(f)), Z, F, it + 1
+
+    z, res, Z, F, it = jax.lax.while_loop(
+        seed_cond, seed_body,
+        (z0f, jnp.asarray(jnp.inf, jnp.float32), Z, F, jnp.asarray(0)),
+    )
 
     def cond(carry):
-        z, prev, Z, F, it = carry
-        res = jnp.max(jnp.abs(z - prev))
+        z, res, Z, F, it = carry
         return jnp.logical_and(it < max_iter, res > tol)
 
     def body(carry):
@@ -122,10 +136,10 @@ def _anderson_iteration(
         alpha = alpha / jnp.sum(alpha, axis=1, keepdims=True)  # [n, m]
         Zs = jnp.transpose(Z, (1, 0, 2))
         z_new = jnp.einsum("nm,nmd->nd", alpha, Zs + beta * Fs)
-        return z_new, z, Z, F, it + 1
+        return z_new, jnp.max(jnp.abs(z_new - z)), Z, F, it + 1
 
     z_final, _, _, _, iters = jax.lax.while_loop(
-        cond, body, (z, Z[m_seed - 1], Z, F, jnp.asarray(m_seed))
+        cond, body, (z, res, Z, F, it)
     )
     return unflatten(z_final), iters
 

@@ -690,7 +690,12 @@ def ring_attention_fn(
 
     Attention dropout (``dropout_rate > 0`` on the flax module, training
     mode) runs in-kernel on the flash path, seeded from the module's
-    dropout rng (requires ``use_flash=True``).
+    dropout rng (requires ``use_flash=True``). The in-kernel masks are
+    independent per (batch, head): flax's ``broadcast_dropout=True``
+    default (one mask shared across batch and heads) is NOT honored on
+    this path — same caveat as
+    :func:`fluxmpi_tpu.ops.flash_attention_fn`'s kernel impl. Use a dense
+    single-device attention if broadcast regularization semantics matter.
 
     ``module.init`` works outside the ``shard_map`` too: with no bound
     ``sp`` axis the ring degrades to exact single-device attention (the

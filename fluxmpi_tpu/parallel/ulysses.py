@@ -174,7 +174,12 @@ def ulysses_attention_fn(
 ):
     """``attention_fn`` drop-in for ``nn.MultiHeadDotProductAttention``
     modules applied inside a sequence-sharding ``shard_map`` (same usage
-    as :func:`fluxmpi_tpu.parallel.ring.ring_attention_fn`)."""
+    as :func:`fluxmpi_tpu.parallel.ring.ring_attention_fn`).
+
+    Attention dropout runs in-kernel with masks independent per
+    (batch, head): flax's ``broadcast_dropout=True`` default is NOT
+    honored on this path (same caveat as
+    :func:`fluxmpi_tpu.ops.flash_attention_fn`'s kernel impl)."""
 
     def fn(query, key, value, bias=None, mask=None, **kwargs):
         if bias is not None or mask is not None:

@@ -1,0 +1,24 @@
+#!/bin/bash
+# Probe the axon TPU tunnel until it comes back; log status to /tmp/tpu_watch.log.
+# One probe at a time, 10-min gaps (wedged-tunnel etiquette).
+LOG=/tmp/tpu_watch.log
+OK=/tmp/tpu_alive
+rm -f "$OK"
+for i in $(seq 1 60); do
+  echo "[$(date -u +%H:%M:%S)] probe attempt $i" >> "$LOG"
+  timeout 300 python -u -c "
+import jax, jax.numpy as jnp
+d = jax.devices()
+x = jnp.ones((256,256), jnp.bfloat16)
+(x@x).block_until_ready()
+print('ALIVE', d[0].platform, d[0].device_kind, len(d))
+" >> "$LOG" 2>&1
+  rc=$?
+  echo "[$(date -u +%H:%M:%S)] rc=$rc" >> "$LOG"
+  if [ $rc -eq 0 ] && grep -q ALIVE "$LOG"; then
+    touch "$OK"
+    echo "[$(date -u +%H:%M:%S)] TPU ALIVE — stopping watch" >> "$LOG"
+    exit 0
+  fi
+  sleep 600
+done

@@ -99,8 +99,9 @@ def test_anchor_table_keyed_by_fingerprint():
 
 def test_run_scaling_config_selection(monkeypatch):
     # On a real multi-chip TPU the scaling mode must run the headline
-    # resnet50 workload and self-label mode "tpu"; elsewhere the mlp
-    # plumbing proxy on the cpu-virtual mesh (VERDICT r3 next #7).
+    # resnet50 workload with stable mode "accelerator" + backend "tpu";
+    # elsewhere the mlp plumbing proxy on the cpu-virtual mesh
+    # (VERDICT r3 next #7; mode/backend split per ADVICE r4).
     calls = []
 
     def fake_run_child(config, timeout, platform, extra_env=None):
@@ -113,7 +114,8 @@ def test_run_scaling_config_selection(monkeypatch):
     out = bench._run_scaling(
         3000.0, {"platform": "tpu", "n_devices": 4}, None
     )
-    assert out["mode"] == "tpu"
+    assert out["mode"] == "accelerator"
+    assert out["backend"] == "tpu"
     assert out["config"] == "resnet50"
     assert [c[0] for c in calls] == ["resnet50", "resnet50"]
     assert calls[0][2]["FLUXMPI_TPU_BENCH_DEVICES"] == "1"
