@@ -74,19 +74,26 @@ def _row_spec(block: int, order):
     return pl.BlockSpec((1, _SUBLANES, block), lambda g0, g1, g2: (g0, 0, order(g1, g2)))
 
 
-def _pos_mask(qi, kj, block_q: int, block_k: int, window: int | None = None):
-    """Causal positional mask for the (qi, kj) tile: True = attend. With
-    ``window``, additionally requires ``q_pos - k_pos < window`` (sliding-
-    window / local attention, Mistral-style)."""
+def _pos_mask(qi, kj, block_q: int, block_k: int, window: int | None = None,
+              causal: bool = True):
+    """Positional mask for the (qi, kj) tile: True = attend. With
+    ``causal``, requires ``q_pos >= k_pos``; with ``window``, additionally
+    requires ``q_pos - k_pos < window`` (sliding-window / local attention,
+    Mistral-style). ``causal=False`` with a window is the band-only mode:
+    only the upper displacement bound applies — the ring-attention
+    past-block primitive, where the causal floor is satisfied globally by
+    the block's ring offset (parallel/ring.py windowed flash schedule).
+    At least one of the two must be active."""
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0
     )
     k_pos = kj * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1
     )
-    mask = q_pos >= k_pos
+    mask = q_pos >= k_pos if causal else None
     if window is not None:
-        mask = mask & (q_pos - k_pos < window)
+        band = q_pos - k_pos < window
+        mask = band if mask is None else mask & band
     return mask
 
 
@@ -208,8 +215,8 @@ def _flash_kernel(
 
     def _tile_mask():
         mask = None
-        if causal:
-            mask = _pos_mask(qi, kj, block_q, block_k, window)
+        if causal or window is not None:
+            mask = _pos_mask(qi, kj, block_q, block_k, window, causal)
         if has_segments:
             # qseg lane-replicated → [block_q, 1] column; kseg
             # sublane-replicated → [1, block_k] row.
@@ -265,8 +272,8 @@ def _flash_kernel(
     preds = []
     if causal:
         preds.append(kj * block_k < (qi + 1) * block_q)
-        if window is not None:
-            preds.append(_window_tile_live(qi, kj, block_q, block_k, window))
+    if window is not None:
+        preds.append(_window_tile_live(qi, kj, block_q, block_k, window))
     if has_segments:
         preds.append(
             jnp.any(_seg_mask(qseg_ref[0][:, :1], kseg_ref[0][:1, :]))
@@ -344,8 +351,8 @@ def _flash_bwd_dq_kernel(
         ) * sm_scale  # [block_q, block_k]
         p = jnp.exp(s - lse)  # normalized probabilities
         mask = None
-        if causal:
-            mask = _pos_mask(qi, kj, block_q, block_k, window)
+        if causal or window is not None:
+            mask = _pos_mask(qi, kj, block_q, block_k, window, causal)
         if has_segments:
             sm = _seg_mask(qseg_ref[0][:, :1], kseg_ref[0][:1, :])
             mask = sm if mask is None else jnp.logical_and(mask, sm)
@@ -369,8 +376,8 @@ def _flash_bwd_dq_kernel(
     preds = []
     if causal:
         preds.append(kj * block_k < (qi + 1) * block_q)
-        if window is not None:
-            preds.append(_window_tile_live(qi, kj, block_q, block_k, window))
+    if window is not None:
+        preds.append(_window_tile_live(qi, kj, block_q, block_k, window))
     if has_segments:
         preds.append(
             jnp.any(_seg_mask(qseg_ref[0][:, :1], kseg_ref[0][:1, :]))
@@ -445,16 +452,17 @@ def _flash_bwd_dkv_kernel(
         # sublane-replicated (→ [1, block_q] row) — the transpose of the
         # fwd/dq layouts.
         mask = None
-        if causal:
+        if causal or window is not None:
             k_pos = kj * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_k, block_q), 0
             )
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_k, block_q), 1
             )
-            mask = q_pos >= k_pos
+            mask = q_pos >= k_pos if causal else None
             if window is not None:
-                mask = mask & (q_pos - k_pos < window)
+                band = q_pos - k_pos < window
+                mask = band if mask is None else mask & band
         if has_segments:
             kseg = kseg_ref[0][:, :1]
             qseg = qseg_ref[0][:1, :]
@@ -514,9 +522,9 @@ def _flash_bwd_dkv_kernel(
         # Skip q-blocks entirely in the past of this k-block (every score
         # masked).
         preds.append((qi + 1) * block_q > kj * block_k)
-        if window is not None:
-            # ...and q-blocks entirely beyond the window's future edge.
-            preds.append(_window_tile_live(qi, kj, block_q, block_k, window))
+    if window is not None:
+        # ...and q-blocks entirely beyond the window's future edge.
+        preds.append(_window_tile_live(qi, kj, block_q, block_k, window))
     if has_segments:
         preds.append(
             jnp.any(
@@ -938,13 +946,22 @@ def _check_dropout(dropout_rate, dropout_seed):
     return rate, jnp.asarray(dropout_seed, jnp.uint32)
 
 
-def _check_window(window, causal):
+def _check_window(window, causal, allow_band: bool = False):
+    """Validate the window. ``allow_band=True`` permits ``causal=False``
+    with a window — the band-only mode (only ``q_pos - k_pos < window``
+    applies), used by ring attention for past blocks whose causal floor is
+    already satisfied globally. ``window`` may then be <= 0 (the band
+    keeps only pairs with ``k_pos > q_pos - window``, i.e. keys far
+    enough ahead locally); a band with no live pair in range yields the
+    well-defined empty result (zero output, lse ≈ -inf)."""
     if window is None:
         return None
     if not causal:
-        raise ValueError(
-            "window (sliding-window attention) requires causal=True"
-        )
+        if not allow_band:
+            raise ValueError(
+                "window (sliding-window attention) requires causal=True"
+            )
+        return int(window)
     window = int(window)
     if window < 1:
         raise ValueError(f"window must be >= 1, got {window}")
@@ -1069,8 +1086,15 @@ def flash_attention_with_lse(
     independently-computed attention blocks (ring attention). Differentiable
     in both outputs (the lse cotangent folds into the backward's dS term).
     Rows with no attendable keys report ``lse ≈ -1e30`` (zero merge weight).
+
+    Unlike :func:`flash_attention`, a ``window`` here does NOT require
+    ``causal=True``: with ``causal=False`` the window applies as a pure
+    band mask (``q_pos - k_pos < window``, no causal floor) — the
+    past-block primitive of the windowed flash ring
+    (:func:`fluxmpi_tpu.parallel.ring.ring_attention`), where block-level
+    ring offsets make every local pair globally causal already.
     """
-    window = _check_window(window, causal)
+    window = _check_window(window, causal, allow_band=True)
     dropout_rate, seed = _check_dropout(dropout_rate, dropout_seed)
     block_q, block_k, interpret = _prepare(q, k, v, block_q, block_k, interpret)
     qseg, kseg = _normalize_segments(

@@ -7,7 +7,7 @@ import jax
 import jax.numpy as jnp
 
 
-def dense_seg_attention(q, k, v, qseg, kseg, causal=False):
+def dense_seg_attention(q, k, v, qseg, kseg, causal=False, window=None):
     """Dense oracle with the kernel's segment semantics: attend iff ids
     equal and key id nonzero. Fully-masked rows are garbage here (uniform
     softmax) — compare valid rows only."""
@@ -17,6 +17,10 @@ def dense_seg_attention(q, k, v, qseg, kseg, causal=False):
     if causal:
         sq, sk = q.shape[1], k.shape[1]
         pos = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        if window is not None:
+            pos = pos & (
+                jnp.arange(sq)[:, None] - jnp.arange(sk)[None, :] < window
+            )
         mask = mask & pos[None]
     s = jnp.where(mask[:, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
