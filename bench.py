@@ -261,7 +261,15 @@ def _bench_workload(
         params = model.init(jax.random.PRNGKey(0), x[:2])
         model_state = None
 
-    step = make_train_step(loss_fn, optimizer, mesh=mesh, style="auto")
+    # Tuning knobs (VERDICT r5 perf session): FLUXMPI_TPU_BENCH_REMAT=1
+    # turns on rematerialization; FLUXMPI_TPU_BENCH_SCAN_STEPS=K compiles
+    # K sequential updates into one dispatch (make_train_step scan_steps)
+    # — isolates host/tunnel dispatch latency from device time. Rates and
+    # FLOPs below are per CALL, so K scales both.
+    remat = os.environ.get("FLUXMPI_TPU_BENCH_REMAT", "0") == "1"
+    scan = max(1, int(os.environ.get("FLUXMPI_TPU_BENCH_SCAN_STEPS", "1")))
+    step = make_train_step(loss_fn, optimizer, mesh=mesh, style="auto",
+                           remat=remat)
     state = replicate(TrainState.create(params, optimizer, model_state), mesh)
     data = shard_batch((x, y), mesh)
 
@@ -278,10 +286,32 @@ def _bench_workload(
     # transcendentals and rematerialized ops differently across versions.
     flops_per_step = analytic_flops if analytic_flops else xla_flops
 
-    rate, state = _steps_per_sec(step, state, data, warmup=3, steps=steps)
+    timed_step, timed_data = step, data
+    if scan > 1:
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as _P
+
+        from fluxmpi_tpu import config as _fm_config
+
+        timed_step = make_train_step(
+            loss_fn, optimizer, mesh=mesh, style="auto", remat=remat,
+            scan_steps=scan,
+        )
+        timed_data = shard_batch(
+            jax.tree_util.tree_map(
+                lambda a: jnp.broadcast_to(a, (scan, *a.shape)), (x, y)
+            ),
+            mesh, spec=_P(None, _fm_config.DP_AXIS_NAME),
+        )
+        if flops_per_step:
+            flops_per_step *= scan
+
+    rate, state = _steps_per_sec(
+        timed_step, state, timed_data, warmup=3, steps=steps
+    )
     mfu = _mfu(flops_per_step, rate, n_dev, device_kind)
 
-    value = round(batch * rate * value_scale / n_dev, ndigits)
+    value = round(batch * scan * rate * value_scale / n_dev, ndigits)
     anchor = _anchor_for(metric_name)
     result = {
         "metric": metric_name,
@@ -294,8 +324,10 @@ def _bench_workload(
     }
     if mfu is not None:
         result["mfu"] = mfu
-    if xla_flops and flops_per_step is not analytic_flops:
+    if xla_flops and analytic_flops is None:
         result["flops_source"] = "xla_cost_analysis"
+    if scan > 1:
+        result["scan_steps"] = scan
 
     if loader_fed:
         fed = _loader_fed_rate(step=step, state=state, x=x, y=y,
@@ -512,11 +544,19 @@ def _bench_transformer():
         from fluxmpi_tpu.models import TransformerLM
         from fluxmpi_tpu.ops import flash_attention_fn
 
+        # Flash block-size re-tune knobs at this seq (the auto-pick tables
+        # were tuned at 2048-8192; VERDICT r5 next #3).
+        blk_q = os.environ.get("FLUXMPI_TPU_LM_BLOCK_Q")
+        blk_k = os.environ.get("FLUXMPI_TPU_LM_BLOCK_K")
         model = TransformerLM(
             vocab_size=vocab, max_len=seq, num_layers=n_layers,
             d_model=d_model, num_heads=n_heads, d_ff=d_ff,
             dtype=jnp.bfloat16,
-            attention_fn=flash_attention_fn(causal=True),
+            attention_fn=flash_attention_fn(
+                causal=True,
+                block_q=int(blk_q) if blk_q else None,
+                block_k=int(blk_k) if blk_k else None,
+            ),
         )
         batch = per_chip * n_dev
         rng = np.random.default_rng(0)
@@ -723,6 +763,8 @@ def _probe_main() -> None:
     platform = os.environ.get("FLUXMPI_TPU_BENCH_PLATFORM")
     if platform == "":
         os.environ.pop("JAX_PLATFORMS", None)
+    if platform is None:
+        platform = os.environ.get("JAX_PLATFORMS") or None
     import jax
 
     if platform:
@@ -773,6 +815,12 @@ def _child_main(config: str) -> None:
     platform = os.environ.get("FLUXMPI_TPU_BENCH_PLATFORM")
     if platform == "":
         os.environ.pop("JAX_PLATFORMS", None)
+    if platform is None:
+        # Direct invocation (or the forced-config path) with an explicit
+        # JAX_PLATFORMS: honor it — the sitecustomize's force-registered
+        # TPU platform would otherwise win and, with a wedged tunnel,
+        # hang backend init rather than fail fast.
+        platform = os.environ.get("JAX_PLATFORMS") or None
     if platform:
         # The environment's sitecustomize may force-register a TPU platform
         # that wins over the JAX_PLATFORMS env var; pin the config directly.

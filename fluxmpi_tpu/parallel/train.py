@@ -117,6 +117,7 @@ def make_train_step(
     batch_spec: P | None = None,
     remat: bool = False,
     grad_accum_steps: int = 1,
+    scan_steps: int = 1,
 ) -> Callable[[TrainState, Any], tuple[TrainState, jax.Array]]:
     """Build a compiled data-parallel train step.
 
@@ -157,6 +158,16 @@ def make_train_step(
         optimizer update — large effective batches without the HBM. The
         leading batch dim of every batch leaf must be divisible by it.
         ``style="auto"`` only.
+      scan_steps: compile this many SEQUENTIAL optimizer updates into one
+        dispatch (an outer ``lax.scan``): every batch leaf carries an
+        extra leading ``scan_steps`` axis, and the step returns the
+        ``[scan_steps]`` per-update losses. One host→device dispatch then
+        drives K updates — amortizing per-step dispatch latency, which on
+        remote/tunneled or very fast chips can otherwise dominate small
+        step times (no analogue in the reference: its per-step NCCL
+        launches are host-driven by construction). Composes with
+        ``grad_accum_steps`` (accumulation nests inside each scanned
+        update). ``style="auto"`` only.
 
     Returns:
       ``step(state, batch) -> (new_state, loss)`` — compiled, collective
@@ -192,6 +203,10 @@ def make_train_step(
         raise ValueError("grad_accum_steps must be >= 1")
     if grad_accum_steps > 1 and style != "auto":
         raise ValueError("grad_accum_steps requires style='auto'")
+    if scan_steps < 1:
+        raise ValueError("scan_steps must be >= 1")
+    if scan_steps > 1 and style != "auto":
+        raise ValueError("scan_steps requires style='auto'")
 
     if style == "auto":
 
@@ -244,11 +259,19 @@ def make_train_step(
                 grads = jax.tree_util.tree_map(lambda x: x / k, g)
                 return _apply_update(ts, _pin_grads(grads), l / k, ms)
 
+        if scan_steps > 1:
+            single = step
+
+            def step(ts: TrainState, batches):
+                return jax.lax.scan(single, ts, batches)
+
         replicated = NamedSharding(mesh, P())
         state_in = replicated if state_sharding is None else state_sharding
-        batch_sharding = NamedSharding(
-            mesh, P(name) if batch_spec is None else batch_spec
-        )
+        spec = P(name) if batch_spec is None else batch_spec
+        if scan_steps > 1:
+            # Leading scan axis is time, not data: unsharded.
+            spec = P(None, *spec)
+        batch_sharding = NamedSharding(mesh, spec)
         return jax.jit(
             step,
             in_shardings=(state_in, batch_sharding),

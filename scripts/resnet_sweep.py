@@ -8,9 +8,12 @@ ONE process (one tunnel lease, one compile cache):
   1. per-chip batch sweep (128 / 256 / 512),
   2. forward-only vs full train step (locates fwd/bwd imbalance),
   3. BN-variant ablation (batch_stats sync on/off, f32 vs bf16 head),
-  4. optional XPlane trace of the best config (--trace).
+  4. scan-steps ablation (--scan K: K optimizer updates per dispatch via
+     make_train_step(scan_steps=K) — isolates host/tunnel dispatch
+     latency, the prime suspect when per-step wall time is tens of ms),
+  5. optional XPlane trace of the best config (--trace).
 
-Usage:  python scripts/resnet_sweep.py [--quick] [--trace]
+Usage:  python scripts/resnet_sweep.py [--quick] [--trace] [--scan 1,8]
 Writes one JSON line per measurement; safe to tee into a log.
 """
 
@@ -18,6 +21,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 
 import numpy as np
@@ -53,7 +57,15 @@ def steps_per_sec(step, state, data, warmup, steps):
 PEAK = 197e12  # v5e bf16
 
 
-def bench_config(batch, *, train=True, steps=20, head_dtype=jnp.float32):
+def _flops_per_image(image: int) -> float:
+    """ResNet-50 forward FLOPs per image: 4.09 GFLOPs at 224px, scaling
+    ~quadratically with image side (conv spatial extents) — keeps smoke
+    runs at other sizes from reporting 224px-inflated MFU."""
+    return 4.09e9 * (image / 224.0) ** 2
+
+
+def bench_config(batch, *, train=True, steps=20, head_dtype=jnp.float32,
+                 scan=1, image=224, dtype=jnp.bfloat16):
     import optax
 
     import fluxmpi_tpu as fm
@@ -62,8 +74,8 @@ def bench_config(batch, *, train=True, steps=20, head_dtype=jnp.float32):
     from fluxmpi_tpu.parallel.train import replicate, shard_batch
 
     mesh = fm.init(devices=jax.devices()[:1])
-    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
-    x = jnp.ones((batch, 224, 224, 3), jnp.bfloat16)
+    model = ResNet50(num_classes=1000, dtype=dtype)
+    x = jnp.ones((batch, image, image, 3), dtype)
     y = jnp.zeros((batch,), jnp.int32)
     variables = model.init(jax.random.PRNGKey(0), x[:2], train=False)
     params, mstate = variables["params"], variables.get("batch_stats")
@@ -81,13 +93,19 @@ def bench_config(batch, *, train=True, steps=20, head_dtype=jnp.float32):
 
     if train:
         step = make_train_step(
-            loss_fn, optax.sgd(0.1, momentum=0.9), mesh=mesh, style="auto"
+            loss_fn, optax.sgd(0.1, momentum=0.9), mesh=mesh, style="auto",
+            scan_steps=scan,
         )
         state = replicate(
             TrainState.create(params, optax.sgd(0.1, momentum=0.9), mstate),
             mesh,
         )
-        flops = 3 * 4.09e9 * batch
+        if scan > 1:
+            # K distinct batches per dispatch; the measured rate below is
+            # per CALL, so flops carries the factor K.
+            x = jnp.broadcast_to(x, (scan, *x.shape))
+            y = jnp.broadcast_to(y, (scan, *y.shape))
+        flops = 3 * _flops_per_image(image) * batch * scan
     else:
         @jax.jit
         def fwd(p, ms, b):
@@ -101,21 +119,37 @@ def bench_config(batch, *, train=True, steps=20, head_dtype=jnp.float32):
             return state, fwd(p, ms, data)
 
         state = (params, mstate)
-        flops = 4.09e9 * batch
+        flops = _flops_per_image(image) * batch
 
-    data = shard_batch((x, y), mesh)
+    from jax.sharding import PartitionSpec as P
+
+    from fluxmpi_tpu import config as fm_config
+
+    dp = fm_config.DP_AXIS_NAME
+    spec = P(None, dp) if (train and scan > 1) else P(dp)
+    data = shard_batch((x, y), mesh, spec=spec)
     t0 = time.perf_counter()
     rate, state = steps_per_sec(step, state, data, warmup=3, steps=steps)
     return {
         "batch": batch,
         "mode": "train" if train else "fwd",
-        "img_per_sec": round(batch * rate, 1),
+        "scan": scan,
+        "image": image,
+        "dtype": jnp.dtype(dtype).name,
+        "img_per_sec": round(batch * scan * rate, 1),
         "mfu": round(flops * rate / PEAK, 4),
         "wall_s": round(time.perf_counter() - t0, 1),
     }
 
 
 def main():
+    # An explicit JAX_PLATFORMS must be pinned in the config too: the
+    # container's sitecustomize force-registers the axon TPU platform,
+    # which wins over the env var — and a wedged tunnel then HANGS
+    # backend init instead of failing fast (see docs/gotchas.md).
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        jax.config.update("jax_platforms", plat)
     try:  # persist compiled programs across sweep invocations
         jax.config.update(
             "jax_compilation_cache_dir", "/tmp/fluxmpi_tpu_xla_cache"
@@ -127,21 +161,35 @@ def main():
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--trace", action="store_true")
     ap.add_argument("--batches", default="128,256,512")
+    ap.add_argument("--scan", default="1",
+                    help="comma list of scan_steps to ablate (train only)")
+    ap.add_argument("--image", type=int, default=224,
+                    help="image side (small values = CPU plumbing smoke)")
+    ap.add_argument("--dtype", default="bfloat16",
+                    help="model/activation dtype (float32 for CPU smoke — "
+                         "bf16 emulation on CPU is pathologically slow)")
     args = ap.parse_args()
+    dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[args.dtype]
 
     batches = [int(b) for b in args.batches.split(",")]
+    scans = [int(s) for s in args.scan.split(",")]
     if args.quick:
         batches = batches[:1]
 
     results = []
     for b in batches:
         for train in (True, False) if not args.quick else (True,):
-            try:
-                r = bench_config(b, train=train, steps=10 if args.quick else 20)
-            except Exception as exc:
-                r = {"batch": b, "train": train, "error": repr(exc)[:200]}
-            results.append(r)
-            print(json.dumps(r), flush=True)
+            for scan in scans if train else [1]:
+                try:
+                    r = bench_config(
+                        b, train=train, steps=10 if args.quick else 20,
+                        scan=scan, image=args.image, dtype=dtype,
+                    )
+                except Exception as exc:
+                    r = {"batch": b, "train": train, "scan": scan,
+                         "error": repr(exc)[:200]}
+                results.append(r)
+                print(json.dumps(r), flush=True)
 
     if args.trace and results:
         best = max(
@@ -153,7 +201,9 @@ def main():
             from fluxmpi_tpu.utils.profiling import profile_trace
 
             with profile_trace("/tmp/resnet_trace"):
-                bench_config(best["batch"], train=True, steps=5)
+                bench_config(best["batch"], train=True, steps=5,
+                             scan=best.get("scan", 1), image=args.image,
+                             dtype=dtype)
             print(json.dumps({"trace": "/tmp/resnet_trace"}), flush=True)
 
 

@@ -1,0 +1,196 @@
+"""One-lease TPU perf session: every round-5 measurement in one process.
+
+The tunnel lease is exclusive and wedges easily (see docs/gotchas.md and
+the verify skill), so ALL hardware asks of the round run back-to-back in
+one interpreter, one compile cache, one lease:
+
+  1. probe          tiny matmul — bail fast if the tunnel is wedged
+  2. resnet-sweep   batch {128,256,512} x scan {1,8} train + fwd-only
+  3. loader-fed     best resnet config driven through
+                    DistributedDataLoader(prefetch=2) + C++ prefetcher
+  4. lm-sweep       transformer LM: batch {8,16} x scan {1,8} x
+                    remat {off,on} + flash block retune at seq 1024
+  5. summary        one JSON line per measurement + a 'best' block to
+                    bake into bench.py env defaults
+
+Usage:  python scripts/tpu_session.py [--budget 3000] [--skip resnet,lm]
+Everything is try/except'd: a failing config prints its error and the
+session moves on. Safe to re-run — compiled programs persist in
+/tmp/fluxmpi_tpu_xla_cache.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tpu_env(extra: dict | None = None) -> dict:
+    """Child env for TPU work: strip a lingering JAX_PLATFORMS (e.g. cpu
+    from the documented CPU-fallback workflow) so children land on the
+    axon TPU backend the probe validated — resnet_sweep pins whatever
+    JAX_PLATFORMS says, so leaving it set could silently run the headline
+    sweep on CPU while reporting v5e MFU."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.update(extra or {})
+    return env
+
+
+def probe(timeout_s: float = 240.0) -> dict | None:
+    """Liveness first: a hung tunnel must not eat the budget."""
+    code = (
+        "import jax, jax.numpy as jnp;"
+        "d = jax.devices();"
+        "x = jnp.ones((256, 256), jnp.bfloat16);"
+        "(x @ x).block_until_ready();"
+        "import json;"
+        "print(json.dumps({'platform': d[0].platform,"
+        " 'kind': d[0].device_kind, 'n': len(d)}))"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout_s, env=_tpu_env(),
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if proc.returncode != 0:
+        print(json.dumps({"probe_error": proc.stderr.strip()[-300:]}),
+              flush=True)
+        return None
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def run_child(argv: list[str], timeout_s: float, env: dict | None = None):
+    """One measurement = one child process: an OOM/compile blowup in a
+    config cannot take down the session (the XLA cache makes respawns
+    cheap)."""
+    full_env = _tpu_env(env)
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            argv, capture_output=True, text=True, timeout=timeout_s,
+            env=full_env, cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return {"argv": argv[-2:], "error": f"timeout {timeout_s}s"}
+    out = []
+    for line in proc.stdout.strip().splitlines():
+        try:
+            out.append(json.loads(line))
+        except json.JSONDecodeError:
+            pass
+    if proc.returncode != 0 and not out:
+        return {"argv": argv[-2:],
+                "error": (proc.stderr or "")[-300:],
+                "wall_s": round(time.time() - t0, 1)}
+    return {"results": out, "wall_s": round(time.time() - t0, 1)}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--budget", type=float, default=3600.0)
+    ap.add_argument("--skip", default="",
+                    help="comma list: resnet,loader,lm,attention")
+    ap.add_argument("--trace", action="store_true",
+                    help="XPlane-trace the best resnet config")
+    args = ap.parse_args()
+    skip = set(s for s in args.skip.split(",") if s)
+    t_start = time.monotonic()
+
+    def remaining() -> float:
+        return args.budget - (time.monotonic() - t_start)
+
+    p = probe()
+    print(json.dumps({"probe": p}), flush=True)
+    if p is None or p.get("platform") == "cpu":
+        print(json.dumps({"session": "aborted", "reason": "no live TPU"}),
+              flush=True)
+        return
+
+    report: dict = {"probe": p, "sections": {}}
+
+    # --- 2. ResNet sweep (the round's #1 ask) -------------------------
+    if "resnet" not in skip and remaining() > 900:
+        r = run_child(
+            [sys.executable, "scripts/resnet_sweep.py",
+             "--batches", "128,256,512", "--scan", "1,8"]
+            + (["--trace"] if args.trace else []),
+            min(2400.0, remaining() - 600),
+        )
+        report["sections"]["resnet_sweep"] = r
+        print(json.dumps({"resnet_sweep": r}), flush=True)
+
+    # --- 3. Loader-fed with the bench's own harness -------------------
+    if "loader" not in skip and remaining() > 600:
+        rows = (report["sections"].get("resnet_sweep") or {}).get("results", [])
+        best = max(
+            (x for x in rows if x.get("mode") == "train" and "mfu" in x),
+            key=lambda x: x["mfu"], default=None,
+        )
+        env = {"FLUXMPI_TPU_BENCH_PLATFORM": ""}
+        if best:
+            env["FLUXMPI_TPU_RESNET_BATCH"] = str(best["batch"])
+            if best.get("scan", 1) > 1:
+                env["FLUXMPI_TPU_BENCH_SCAN_STEPS"] = str(best["scan"])
+        r = run_child(
+            [sys.executable, "bench.py", "--child", "resnet50"],
+            min(1200.0, remaining() - 300), env,
+        )
+        report["sections"]["resnet_bench_child"] = r
+        print(json.dumps({"resnet_bench_child": r}), flush=True)
+
+    # --- 4. Transformer LM sweep --------------------------------------
+    if "lm" not in skip and remaining() > 300:
+        lm_rows = []
+        grid: list[dict] = [
+            {},  # r3 baseline config
+            {"FLUXMPI_TPU_BENCH_SCAN_STEPS": "8"},
+            {"FLUXMPI_TPU_LM_BATCH": "16"},
+            {"FLUXMPI_TPU_LM_BATCH": "16",
+             "FLUXMPI_TPU_BENCH_SCAN_STEPS": "8"},
+            {"FLUXMPI_TPU_BENCH_REMAT": "1", "FLUXMPI_TPU_LM_BATCH": "32"},
+            {"FLUXMPI_TPU_LM_BLOCK_Q": "512", "FLUXMPI_TPU_LM_BLOCK_K": "1024"},
+            {"FLUXMPI_TPU_LM_BLOCK_Q": "256", "FLUXMPI_TPU_LM_BLOCK_K": "512"},
+        ]
+        for env in grid:
+            if remaining() < 240:
+                lm_rows.append({"env": env, "error": "budget exhausted"})
+                break
+            env = {"FLUXMPI_TPU_BENCH_PLATFORM": "", **env}
+            r = run_child(
+                [sys.executable, "bench.py", "--child", "transformer"],
+                min(600.0, remaining() - 60), env,
+            )
+            row = {"env": {k: v for k, v in env.items()
+                           if k != "FLUXMPI_TPU_BENCH_PLATFORM"}, **r}
+            lm_rows.append(row)
+            print(json.dumps({"lm": row}), flush=True)
+        report["sections"]["lm_sweep"] = lm_rows
+
+    # --- 5. Attention kernels (r4 layout change never TPU-validated) --
+    if "attention" not in skip and remaining() > 300:
+        r = run_child(
+            [sys.executable, "bench.py", "--child", "attention"],
+            min(900.0, remaining() - 30), {"FLUXMPI_TPU_BENCH_PLATFORM": ""},
+        )
+        report["sections"]["attention"] = r
+        print(json.dumps({"attention": r}), flush=True)
+
+    with open("/tmp/tpu_session_report.json", "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps({"session": "done",
+                      "report": "/tmp/tpu_session_report.json",
+                      "wall_s": round(time.monotonic() - t_start, 1)}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

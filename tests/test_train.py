@@ -206,6 +206,55 @@ def test_grad_accum_matches_full_batch(world):
     assert int(s2.step) == 1  # one update, not four
 
 
+def test_scan_steps_match_sequential(world):
+    """K scanned updates in one dispatch == K sequential single-step calls
+    (same updates in the same order; [K] per-update losses returned)."""
+    from jax.sharding import PartitionSpec as P
+
+    from fluxmpi_tpu.parallel import make_train_step
+    from fluxmpi_tpu.parallel.train import replicate, shard_batch
+
+    model, params, optimizer, state, loss_fn, batch = _setup(world)
+    K = 3
+    rng = np.random.default_rng(7)
+    xs = rng.normal(size=(K, 16, 2)).astype(np.float32)
+    ys = rng.normal(size=(K, 16, 1)).astype(np.float32)
+
+    single = make_train_step(loss_fn, optimizer, style="auto", donate=False)
+    s1 = replicate(state)
+    losses_seq = []
+    for i in range(K):
+        s1, l = single(s1, shard_batch((xs[i], ys[i])))
+        losses_seq.append(float(l))
+
+    scanned = make_train_step(
+        loss_fn, optimizer, style="auto", donate=False, scan_steps=K
+    )
+    s2, losses = scanned(
+        replicate(state), shard_batch((xs, ys), spec=P(None, "dp"))
+    )
+    assert losses.shape == (K,)
+    np.testing.assert_allclose(np.asarray(losses), losses_seq, rtol=1e-5)
+    assert int(s2.step) == K
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        ),
+        s1.params,
+        s2.params,
+    )
+
+
+def test_scan_steps_requires_auto(world):
+    from fluxmpi_tpu.parallel import make_train_step
+
+    model, params, optimizer, state, loss_fn, batch = _setup(world)
+    with pytest.raises(ValueError, match="scan_steps"):
+        make_train_step(
+            loss_fn, optimizer, style="shard_map", scan_steps=2
+        )
+
+
 def test_grad_accum_divisibility_error(world):
     from fluxmpi_tpu.parallel import make_train_step
     from fluxmpi_tpu.parallel.train import replicate, shard_batch
