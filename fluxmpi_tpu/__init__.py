@@ -62,4 +62,5 @@ from .data import (  # noqa: F401,E402
     ArrayDataset,
     DistributedDataContainer,
     DistributedDataLoader,
+    scan_batches,
 )

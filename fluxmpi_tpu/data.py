@@ -25,12 +25,18 @@ from typing import Any, Iterator, Sequence
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import config
 from .runtime import global_mesh
 
-__all__ = ["ArrayDataset", "DistributedDataContainer", "DistributedDataLoader"]
+__all__ = [
+    "ArrayDataset",
+    "DistributedDataContainer",
+    "DistributedDataLoader",
+    "scan_batches",
+]
 
 
 class ArrayDataset:
@@ -390,3 +396,32 @@ class DistributedDataLoader:
             idxs = order[b * self.local_batch_size : stop]
             batch = _stack_samples([source[int(i)] for i in idxs])
             yield _globalize(batch)
+
+
+def scan_batches(
+    loader: "DistributedDataLoader", k: int
+) -> Iterator[Any]:
+    """Group consecutive loader batches into ``[k]``-stacked super-batches
+    for :func:`fluxmpi_tpu.parallel.make_train_step` with
+    ``scan_steps=k`` — the loader-side half of multi-step dispatch (one
+    host→device dispatch drives k optimizer updates).
+
+    The leading axis is scan time, not data: the stacked leaves are laid
+    out ``P(None, <loader's batch axis>)``. A ragged trailing group
+    (fewer than ``k`` batches left in the epoch) is dropped, mirroring
+    the loader's ``drop_last`` rationale — a short scan axis would
+    retrigger XLA compilation.
+    """
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    mesh = loader.mesh or global_mesh()
+    sharding = NamedSharding(mesh, P(None, loader.axis_name))
+    group: list[Any] = []
+    for batch in loader:
+        group.append(batch)
+        if len(group) == k:
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *group
+            )
+            yield jax.device_put(stacked, sharding)
+            group = []

@@ -346,3 +346,48 @@ def test_set_epoch_reproduces_resumed_shuffle(world):
         assert not all(
             np.array_equal(a, b) for a, b in zip(epochs[0], epochs[2])
         )
+
+
+def test_scan_batches_feeds_scan_steps(world):
+    # Loader-side half of multi-step dispatch: scan_batches(loader, k)
+    # stacks k consecutive global batches on a leading scan axis
+    # (P(None, dp)), the ragged tail group is dropped, and the result
+    # drives make_train_step(scan_steps=k) directly.
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    import fluxmpi_tpu as fm
+    from fluxmpi_tpu.data import scan_batches
+    from fluxmpi_tpu.models import MLP
+    from fluxmpi_tpu.parallel import TrainState, make_train_step
+    from fluxmpi_tpu.parallel.train import replicate
+
+    xs = np.arange(56, dtype=np.float32).reshape(56, 1)
+    ys = xs * 2.0
+    loader = fm.DistributedDataLoader(
+        fm.ArrayDataset((xs, ys)), 8, prefetch=0
+    )
+    groups = list(scan_batches(loader, 3))
+    # 7 batches of 8 -> 2 full groups of 3, tail dropped.
+    assert len(groups) == 2
+    gx, gy = groups[0]
+    assert gx.shape == (3, 8, 1)
+    assert gx.sharding.spec == P(None, "dp")
+    # Content: consecutive loader batches in order.
+    np.testing.assert_array_equal(np.asarray(gx).ravel(), xs[:24].ravel())
+
+    model = MLP(features=(4, 1))
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 1)))
+    opt = optax.sgd(0.01)
+
+    def loss_fn(p, ms, batch):
+        bx, by = batch
+        return jnp.mean((model.apply(p, bx) - by) ** 2), ms
+
+    step = make_train_step(loss_fn, opt, style="auto", donate=False,
+                           scan_steps=3)
+    state = replicate(TrainState.create(params, opt))
+    state, losses = step(state, groups[0])
+    assert losses.shape == (3,)
+    assert int(state.step) == 3
