@@ -1,8 +1,12 @@
 #!/bin/bash
-# Probe the axon TPU tunnel until it comes back; log status to /tmp/tpu_watch.log.
+# Probe the axon TPU tunnel until it comes back; when it does, run the
+# one-lease perf session immediately (scripts/tpu_session.py) so zero
+# chip time is wasted waiting for a human/agent poll.
 # One probe at a time, 10-min gaps (wedged-tunnel etiquette).
 LOG=/tmp/tpu_watch.log
 OK=/tmp/tpu_alive
+SESSION_LOG=/tmp/tpu_session.log
+cd "$(dirname "$0")/.." || exit 1
 rm -f "$OK"
 for i in $(seq 1 60); do
   echo "[$(date -u +%H:%M:%S)] probe attempt $i" >> "$LOG"
@@ -17,7 +21,9 @@ print('ALIVE', d[0].platform, d[0].device_kind, len(d))
   echo "[$(date -u +%H:%M:%S)] rc=$rc" >> "$LOG"
   if [ $rc -eq 0 ] && grep -q ALIVE "$LOG"; then
     touch "$OK"
-    echo "[$(date -u +%H:%M:%S)] TPU ALIVE — stopping watch" >> "$LOG"
+    echo "[$(date -u +%H:%M:%S)] TPU ALIVE - starting one-lease session" >> "$LOG"
+    timeout 5400 python scripts/tpu_session.py --budget 4500 --trace > "$SESSION_LOG" 2>&1
+    echo "[$(date -u +%H:%M:%S)] session done rc=$? (report: /tmp/tpu_session_report.json)" >> "$LOG"
     exit 0
   fi
   sleep 600
