@@ -377,6 +377,27 @@ def _loader_fed_rate(*, step, state, x, y, mesh, n_dev) -> float | None:
 
         _, state = run(2, state)  # warmup: prefetcher spin-up
         rate, state = run(8, state)
+
+        # Diagnostic sub-rates so a gap vs synthetic is attributable in
+        # ONE session: host-side batch assembly alone (loader iteration,
+        # no step — includes the C++ gather and the host→device
+        # transfers it initiates), printed to stderr, never the metric.
+        try:
+            t0 = time.perf_counter()
+            n_loader = 0
+            for _ in range(2):
+                for data in loader:
+                    jax.block_until_ready(data)
+                    n_loader += 1
+            assembly = batch * n_loader / (time.perf_counter() - t0)
+            print(
+                f"bench: loader diagnostics: assembly+transfer alone "
+                f"{assembly:.1f} samples/s vs loader-fed "
+                f"{batch * rate:.1f}",
+                file=sys.stderr,
+            )
+        except Exception:
+            pass
         return batch * rate / n_dev
     except Exception as exc:  # pragma: no cover - diagnostics only
         print(f"bench: loader-fed path failed: {exc!r}", file=sys.stderr)
