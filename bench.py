@@ -588,8 +588,16 @@ def _bench_transformer():
             rng.integers(0, vocab, size=(batch, seq)).astype(np.int32)
         )
 
+        # Chunked fused unembed+CE head (ops/fused_ce.py): the [B·S, V]
+        # logits tensor (0.5-1 GB at this config) is never materialized.
+        # Default on; FLUXMPI_TPU_LM_FUSED_CE=0 restores the dense head
+        # for A/B.
+        fused_ce = os.environ.get("FLUXMPI_TPU_LM_FUSED_CE", "1") == "1"
+
         def loss_fn(p, mstate, b):
             bx, by = b
+            if fused_ce:
+                return model.apply(p, bx, train=True, targets=by).mean(), mstate
             logits = model.apply(p, bx, train=True)
             loss = optax.softmax_cross_entropy_with_integer_labels(
                 logits.astype(jnp.float32), by

@@ -126,7 +126,15 @@ class TransformerLM(nn.Module):
         )
 
     @nn.compact
-    def __call__(self, tokens, *, train: bool = True):
+    def __call__(self, tokens, *, train: bool = True, targets=None,
+                 loss_chunk: int = 8192):
+        """Returns logits ``[..., vocab]``; or, with ``targets`` (int
+        labels, same shape as ``tokens``), the per-token cross-entropy
+        losses computed by the chunked fused head
+        (:func:`fluxmpi_tpu.ops.unembed_cross_entropy`) — the
+        ``[tokens, vocab]`` logits tensor is never materialized, and the
+        head matmuls run in the model dtype with f32 accumulation.
+        ``loss_chunk`` tiles the vocab on that path."""
         embed = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype, name="embed")
         pos = self.param(
             "pos_embed",
@@ -138,4 +146,15 @@ class TransformerLM(nn.Module):
         # causal mask
         mask = nn.make_causal_mask(tokens)
         x = self.make_encoder()(x, train=train, mask=mask)
+        if targets is not None:
+            from ..ops import unembed_cross_entropy
+
+            # The table passes through in its own (f32 param) dtype: the
+            # op casts tiles to x's dtype for the MXU but returns the
+            # embedding gradient un-quantized — same optimizer numerics
+            # as the dense head for the model's largest parameter.
+            return unembed_cross_entropy(
+                x.astype(self.dtype), embed.embedding, targets,
+                chunk=loss_chunk,
+            )
         return embed.attend(x.astype(jnp.float32))

@@ -6,3 +6,4 @@ from .flash_attention import (  # noqa: F401
     flash_attention_with_lse,
     padding_to_segment_ids,
 )
+from .fused_ce import unembed_cross_entropy  # noqa: F401

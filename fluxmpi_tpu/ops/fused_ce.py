@@ -1,0 +1,193 @@
+"""Chunked fused unembed + softmax cross-entropy.
+
+The LM-head analogue of flash attention: the ``[tokens, vocab]`` logits
+matrix of a language model head is the largest single tensor in the
+training step (batch 8 x seq 1024 x vocab 32768 in f32 is 1 GB — bigger
+than the model), yet the loss needs only one scalar per token. This op
+streams the unembedding matmul over vocab tiles inside one ``lax.scan``,
+keeping a running logsumexp and the target logit — the full logits tensor
+is NEVER materialized, forward or backward. Peak memory drops from
+O(tokens·vocab) to O(tokens·chunk). The matmuls run in the HIDDEN
+STATES' dtype (bf16 on TPU) with f32 accumulation; the embedding table
+may stay f32 — it is cast per-tile for the MXU, and its gradient comes
+back in its own dtype (f32 moments for the model's largest parameter).
+
+No analogue in the reference (its models are user-land Flux code;
+README.md:31-70 quick-start): this is TPU-native performance surface, the
+same memory-vs-recompute trade `jax.checkpoint` makes but specialized to
+the head, where recomputation is one chunked matmul per direction.
+
+Backward math, per tile c with logits ``z_c = h @ W_cᵀ``:
+``dz_c = (softmax(z)_c - onehot_c) * g`` → ``dh += dz_c @ W_c`` and
+``dW_c = dz_cᵀ @ h`` — softmax rebuilt from the saved per-token
+logsumexp, so the residuals are just ``(h, W, targets, lse)``.
+
+Vocab sizes that don't divide ``chunk`` are handled by zero-padding the
+last tile and masking its dead columns to -inf (their softmax weight is
+exactly 0, so forward and backward are untouched) — the tile size never
+silently shrinks (GPT-2's 50257 runs 7 tiles of 8192, not 29 of 1733).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["unembed_cross_entropy"]
+
+
+def _tiles(W, chunk: int):
+    """Pad ``W`` [V, d] to a whole number of ``chunk``-row tiles and
+    return ``(W3 [K, chunk, d], offsets [K])``. Shared by the primal,
+    fwd, and bwd so the tiling cannot diverge between them."""
+    vocab, d = W.shape
+    pad = (-vocab) % chunk
+    if pad:
+        W = jnp.concatenate([W, jnp.zeros((pad, d), W.dtype)], axis=0)
+    k = W.shape[0] // chunk
+    offsets = jnp.arange(k, dtype=jnp.int32) * chunk
+    return W.reshape(k, chunk, d), offsets
+
+
+def _col_mask(off, chunk: int, vocab: int):
+    """[1, chunk] validity mask for a tile starting at ``off`` (False on
+    the zero-padded columns past the real vocab)."""
+    return (off + jnp.arange(chunk))[None, :] < vocab
+
+
+def _scan_lse(h2, W3, offsets, targets1, vocab: int):
+    """Shared forward scan: running (m, l, target-logit) over vocab
+    tiles. h2 [N, d]; W3 [K, C, d]; targets1 [N]. Returns (lse [N],
+    t [N]) in f32."""
+    n = h2.shape[0]
+    chunk = W3.shape[1]
+
+    def body(carry, xs):
+        m, l, t = carry
+        w_c, off = xs
+        z = jax.lax.dot_general(
+            h2, w_c.astype(h2.dtype), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [N, C]
+        z = jnp.where(_col_mask(off, chunk, vocab), z, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(z, axis=-1))
+        l = l * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(z - m_new[:, None]), axis=-1
+        )
+        local = targets1 - off
+        in_chunk = (local >= 0) & (local < chunk)
+        picked = jnp.take_along_axis(
+            z, jnp.clip(local, 0, chunk - 1)[:, None], axis=1
+        )[:, 0]
+        t = jnp.where(in_chunk, picked, t)
+        return (m_new, l, t), None
+
+    init = (
+        jnp.full((n,), -jnp.inf, jnp.float32),
+        jnp.zeros((n,), jnp.float32),
+        jnp.zeros((n,), jnp.float32),
+    )
+    (m, l, t), _ = jax.lax.scan(body, init, (W3, offsets))
+    return m + jnp.log(l), t
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _fused_ce(h2, W, targets1, chunk):
+    return _fused_ce_fwd(h2, W, targets1, chunk)[0]
+
+
+def _fused_ce_fwd(h2, W, targets1, chunk):
+    W3, offsets = _tiles(W, chunk)
+    lse, t = _scan_lse(h2, W3, offsets, targets1, W.shape[0])
+    return lse - t, (h2, W, targets1, lse)
+
+
+def _fused_ce_bwd(chunk, res, g):
+    h2, W, targets1, lse = res
+    vocab, d = W.shape
+    n = h2.shape[0]
+    W3, offsets = _tiles(W, chunk)
+    gf = g.astype(jnp.float32)
+
+    def body(dh, xs):
+        w_c, off = xs
+        z = jax.lax.dot_general(
+            h2, w_c.astype(h2.dtype), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [N, C]
+        z = jnp.where(_col_mask(off, chunk, vocab), z, -jnp.inf)
+        p = jnp.exp(z - lse[:, None])  # 0 exactly on padded columns
+        local = targets1 - off
+        in_chunk = (local >= 0) & (local < chunk)
+        onehot = (
+            jax.nn.one_hot(
+                jnp.clip(local, 0, chunk - 1), chunk, dtype=jnp.float32
+            )
+            * in_chunk[:, None]
+        )
+        dz = (p - onehot) * gf[:, None]  # [N, C]
+        dh = dh + jax.lax.dot_general(
+            dz, w_c.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dw_c = jax.lax.dot_general(
+            dz, h2.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [C, d]
+        return dh, dw_c
+
+    dh, dW3 = jax.lax.scan(
+        body, jnp.zeros((n, d), jnp.float32), (W3, offsets)
+    )
+    dW = dW3.reshape(-1, d)[:vocab]  # drop the zero-pad rows
+    return dh.astype(h2.dtype), dW.astype(W.dtype), None
+
+
+_fused_ce.defvjp(_fused_ce_fwd, _fused_ce_bwd)
+
+
+def unembed_cross_entropy(
+    h: jnp.ndarray,
+    embedding: jnp.ndarray,
+    targets: jnp.ndarray,
+    *,
+    chunk: int = 8192,
+) -> jnp.ndarray:
+    """Per-token ``softmax_cross_entropy(h @ embeddingᵀ, targets)`` without
+    materializing the logits.
+
+    Args:
+      h: hidden states ``[..., d_model]`` — the matmuls run in THIS
+        dtype (pass bf16 for MXU speed) with f32 accumulation.
+      embedding: ``[vocab, d_model]`` — the ``nn.Embed`` table of a
+        weight-tied head (what ``embed.attend`` contracts against). May
+        be f32 while ``h`` is bf16: tiles are cast for the matmul, and
+        the gradient returns in the table's own dtype.
+      targets: int labels, shape ``h.shape[:-1]``.
+      chunk: vocab tile size; a trailing partial tile is zero-padded and
+        masked (never silently shrunk). Peak memory is O(tokens·chunk).
+
+    Returns:
+      Per-token losses with shape ``h.shape[:-1]``, f32 — same values as
+      ``optax.softmax_cross_entropy_with_integer_labels(h @ embeddingᵀ,
+      targets)`` up to accumulation order.
+    """
+    if h.shape[:-1] != targets.shape:
+        raise ValueError(
+            f"targets shape {targets.shape} must equal the hidden states' "
+            f"leading shape {h.shape[:-1]}"
+        )
+    vocab, d = embedding.shape
+    if h.shape[-1] != d:
+        raise ValueError(
+            f"hidden dim {h.shape[-1]} != embedding dim {d}"
+        )
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    lead = h.shape[:-1]
+    h2 = h.reshape(-1, d)
+    targets1 = targets.reshape(-1).astype(jnp.int32)
+    out = _fused_ce(h2, embedding, targets1, min(chunk, vocab))
+    return out.reshape(lead)

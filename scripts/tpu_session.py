@@ -151,11 +151,13 @@ def main() -> None:
     if "lm" not in skip and remaining() > 300:
         lm_rows = []
         grid: list[dict] = [
-            {},  # r3 baseline config
+            {},  # fused-CE head (default), r3 batch
+            {"FLUXMPI_TPU_LM_FUSED_CE": "0"},  # dense-head A/B
             {"FLUXMPI_TPU_BENCH_SCAN_STEPS": "8"},
             {"FLUXMPI_TPU_LM_BATCH": "16"},
             {"FLUXMPI_TPU_LM_BATCH": "16",
              "FLUXMPI_TPU_BENCH_SCAN_STEPS": "8"},
+            {"FLUXMPI_TPU_LM_BATCH": "32"},  # fused head frees the logits HBM
             {"FLUXMPI_TPU_BENCH_REMAT": "1", "FLUXMPI_TPU_LM_BATCH": "32"},
             {"FLUXMPI_TPU_LM_BLOCK_Q": "512", "FLUXMPI_TPU_LM_BLOCK_K": "1024"},
             {"FLUXMPI_TPU_LM_BLOCK_Q": "256", "FLUXMPI_TPU_LM_BLOCK_K": "512"},

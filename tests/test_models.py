@@ -467,3 +467,38 @@ def test_deq_broyden_grads_match_damped(world):
     for a, b in zip(jax.tree_util.tree_leaves(gb),
                     jax.tree_util.tree_leaves(gd)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-3)
+
+
+def test_transformer_fused_loss_matches_dense_head(world):
+    # targets= path: per-token losses from the chunked fused head equal
+    # softmax-CE over the dense logits (same params, f32 model dtype),
+    # and gradients agree — the [tokens, vocab] tensor is never built.
+    import optax
+
+    from fluxmpi_tpu.models import TransformerLM
+
+    lm = TransformerLM(vocab_size=64, max_len=32, num_layers=2, d_model=32,
+                       num_heads=4, d_ff=64)
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, 64, size=(2, 16)).astype(np.int32))
+    tgts = jnp.asarray(rng.integers(0, 64, size=(2, 16)).astype(np.int32))
+    variables = lm.init(jax.random.PRNGKey(0), toks, train=False)
+
+    def fused(v):
+        return jnp.mean(lm.apply(v, toks, train=False, targets=tgts,
+                                 loss_chunk=16))
+
+    def dense(v):
+        logits = lm.apply(v, toks, train=False)
+        return jnp.mean(optax.softmax_cross_entropy_with_integer_labels(
+            logits, tgts))
+
+    lf, gf = jax.value_and_grad(fused)(variables)
+    ld, gd = jax.value_and_grad(dense)(variables)
+    np.testing.assert_allclose(float(lf), float(ld), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5, rtol=1e-4
+        ),
+        gf, gd,
+    )

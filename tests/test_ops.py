@@ -1031,3 +1031,101 @@ def test_flash_fn_kernel_dropout_path(world):
 
     with pytest.raises(ValueError, match="dropout_impl"):
         flash_attention_fn(dropout_impl="bogus")
+
+
+# ---- chunked fused unembed + cross-entropy (round-5 perf surface) ----
+
+
+def _ce_oracle(h, W, targets):
+    logits = (h.astype(jnp.float32) @ W.astype(jnp.float32).T)
+    import optax
+
+    return optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+
+
+@pytest.mark.parametrize("chunk", [7, 16, 64, 100])
+def test_unembed_ce_matches_dense(world, chunk):
+    # chunk=7 and 100: the trailing vocab tile is zero-padded and masked
+    # (64 % 7 != 0; 100 > 64 clamps to one full tile) — the tile size is
+    # never silently shrunk.
+    from fluxmpi_tpu.ops import unembed_cross_entropy
+
+    rng = np.random.default_rng(0)
+    b, s, d, v = 2, 8, 16, 64
+    h = jnp.asarray(rng.normal(size=(b, s, d)).astype(np.float32))
+    W = jnp.asarray(rng.normal(size=(v, d)).astype(np.float32) * 0.3)
+    t = jnp.asarray(rng.integers(0, v, size=(b, s)).astype(np.int32))
+    out = unembed_cross_entropy(h, W, t, chunk=chunk)
+    expected = _ce_oracle(h.reshape(-1, d), W, t.reshape(-1)).reshape(b, s)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               atol=2e-5, rtol=1e-5)
+
+
+def test_unembed_ce_grads_match_dense(world):
+    from fluxmpi_tpu.ops import unembed_cross_entropy
+
+    rng = np.random.default_rng(1)
+    n, d, v = 24, 16, 48
+    h = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+    W = jnp.asarray(rng.normal(size=(v, d)).astype(np.float32) * 0.3)
+    t = jnp.asarray(rng.integers(0, v, size=(n,)).astype(np.int32))
+    # Non-uniform per-token cotangents through a weighted mean.
+    wgt = jnp.asarray(rng.uniform(0.5, 1.5, size=(n,)).astype(np.float32))
+
+    def loss_fused(h, W):
+        return jnp.sum(unembed_cross_entropy(h, W, t, chunk=16) * wgt)
+
+    def loss_dense(h, W):
+        return jnp.sum(_ce_oracle(h, W, t) * wgt)
+
+    gf = jax.grad(loss_fused, argnums=(0, 1))(h, W)
+    gd = jax.grad(loss_dense, argnums=(0, 1))(h, W)
+    np.testing.assert_allclose(np.asarray(gf[0]), np.asarray(gd[0]),
+                               atol=5e-5, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(gf[1]), np.asarray(gd[1]),
+                               atol=5e-5, rtol=1e-4)
+
+
+def test_unembed_ce_bf16_operands(world):
+    # bf16 h/W with f32 accumulation: close to the f32 oracle at bf16
+    # tolerance, and gradients come back in the operand dtypes.
+    from fluxmpi_tpu.ops import unembed_cross_entropy
+
+    rng = np.random.default_rng(2)
+    n, d, v = 16, 32, 64
+    h32 = rng.normal(size=(n, d)).astype(np.float32)
+    W32 = (rng.normal(size=(v, d)) * 0.3).astype(np.float32)
+    t = jnp.asarray(rng.integers(0, v, size=(n,)).astype(np.int32))
+    h = jnp.asarray(h32, jnp.bfloat16)
+    W = jnp.asarray(W32, jnp.bfloat16)
+    out = unembed_cross_entropy(h, W, t, chunk=16)
+    assert out.dtype == jnp.float32
+    expected = _ce_oracle(
+        jnp.asarray(h32, jnp.bfloat16), jnp.asarray(W32, jnp.bfloat16), t
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               atol=5e-2, rtol=5e-2)
+    gh, gW = jax.grad(
+        lambda h, W: jnp.mean(unembed_cross_entropy(h, W, t, chunk=16)),
+        argnums=(0, 1),
+    )(h, W)
+    assert gh.dtype == jnp.bfloat16 and gW.dtype == jnp.bfloat16
+
+    # Mixed: bf16 hidden states against an f32 table (the weight-tied
+    # model layout) — the table's gradient comes back f32, un-quantized.
+    gh, gW = jax.grad(
+        lambda h, W: jnp.mean(unembed_cross_entropy(h, W, t, chunk=16)),
+        argnums=(0, 1),
+    )(h, jnp.asarray(W32))
+    assert gh.dtype == jnp.bfloat16 and gW.dtype == jnp.float32
+
+
+def test_unembed_ce_shape_errors(world):
+    from fluxmpi_tpu.ops import unembed_cross_entropy
+
+    h = jnp.ones((2, 4, 8))
+    W = jnp.ones((16, 8))
+    with pytest.raises(ValueError, match="targets shape"):
+        unembed_cross_entropy(h, W, jnp.zeros((2, 3), jnp.int32))
+    with pytest.raises(ValueError, match="hidden dim"):
+        unembed_cross_entropy(h, jnp.ones((16, 9)), jnp.zeros((2, 4), jnp.int32))
