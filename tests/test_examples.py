@@ -30,6 +30,7 @@ _CASES = [
     ("vit_classification.py", ["--simulate", "8", "--epochs", "2"],
      "VIT_EXAMPLE_OK"),
     ("adapter_sync.py", ["--simulate", "8"], "ADAPTER_SYNC_OK"),
+    ("lm_pretrain.py", ["--simulate", "8"], "LM_PRETRAIN_OK"),
     ("parallelism_3d.py", [], "PARALLELISM_3D_OK"),
     ("long_context_zigzag.py", [], "LONG_CONTEXT_ZIGZAG_OK"),
 ]

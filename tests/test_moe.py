@@ -562,3 +562,24 @@ def test_router_z_loss_sowed(world):
     np.testing.assert_allclose(
         float(mutated["losses"]["moe_router_z_loss"][0]), expected, rtol=1e-5
     )
+
+
+def test_moe_lm_fused_loss_path(world):
+    # The fused targets= head is inherited by the MoE LM (it only
+    # overrides make_encoder); losses still sow through mutable state.
+    from fluxmpi_tpu.models import MoETransformerLM, collect_moe_losses
+
+    model = MoETransformerLM(
+        vocab_size=64, max_len=32, num_layers=1, d_model=32, num_heads=4,
+        d_ff=64, num_experts=2,
+    )
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, 64, size=(2, 16)).astype(np.int32))
+    tgts = jnp.asarray(rng.integers(0, 64, size=(2, 16)).astype(np.int32))
+    params = model.init(jax.random.PRNGKey(0), toks, train=False)
+    losses, mutated = model.apply(
+        params, toks, train=True, targets=tgts, mutable=["losses"]
+    )
+    assert losses.shape == (2, 16)
+    aux, zl = collect_moe_losses(mutated["losses"])
+    assert np.isfinite(float(jnp.mean(losses) + aux + zl))
