@@ -266,7 +266,8 @@ def _bench_workload(
     # K sequential updates into one dispatch (make_train_step scan_steps)
     # — isolates host/tunnel dispatch latency from device time. Rates and
     # FLOPs below are per CALL, so K scales both.
-    remat = os.environ.get("FLUXMPI_TPU_BENCH_REMAT", "0") == "1"
+    remat_env = os.environ.get("FLUXMPI_TPU_BENCH_REMAT", "0")
+    remat = "dots" if remat_env == "dots" else remat_env == "1"
     scan = max(1, int(os.environ.get("FLUXMPI_TPU_BENCH_SCAN_STEPS", "1")))
     step = make_train_step(loss_fn, optimizer, mesh=mesh, style="auto",
                            remat=remat)

@@ -152,7 +152,11 @@ def make_train_step(
         ``style="auto"`` only.
       remat: rematerialize the forward pass during the backward
         (``jax.checkpoint`` on the loss) — trades FLOPs for HBM so larger
-        per-chip batches / longer sequences fit.
+        per-chip batches / longer sequences fit. ``True`` saves nothing
+        (recompute everything); the string ``"dots"`` applies the
+        ``checkpoint_dots`` policy instead — matmul outputs are saved,
+        only the cheap elementwise work recomputes (usually the better
+        trade on TPU, where the MXU is the scarce resource).
       grad_accum_steps: split each batch into this many microbatches and
         accumulate (mean) gradients over a ``lax.scan`` before the single
         optimizer update — large effective batches without the HBM. The
@@ -183,7 +187,17 @@ def make_train_step(
         raise ValueError("grad_reduce must be 'mean', 'sum', or None")
 
     if remat:
-        loss_fn = jax.checkpoint(loss_fn)
+        if remat == "dots":
+            loss_fn = jax.checkpoint(
+                loss_fn,
+                policy=jax.checkpoint_policies.checkpoint_dots,
+            )
+        elif remat is True:
+            loss_fn = jax.checkpoint(loss_fn)
+        else:
+            raise ValueError(
+                f"remat must be False, True, or 'dots', got {remat!r}"
+            )
     grad_and_aux = jax.value_and_grad(loss_fn, has_aux=True)
 
     def _apply_update(ts: TrainState, grads, loss, new_mstate):

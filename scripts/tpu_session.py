@@ -158,6 +158,7 @@ def main() -> None:
             {"FLUXMPI_TPU_LM_BATCH": "16",
              "FLUXMPI_TPU_BENCH_SCAN_STEPS": "8"},
             {"FLUXMPI_TPU_LM_BATCH": "32"},  # fused head frees the logits HBM
+            {"FLUXMPI_TPU_BENCH_REMAT": "dots", "FLUXMPI_TPU_LM_BATCH": "32"},
             {"FLUXMPI_TPU_BENCH_REMAT": "1", "FLUXMPI_TPU_LM_BATCH": "32"},
             {"FLUXMPI_TPU_LM_BLOCK_Q": "512", "FLUXMPI_TPU_LM_BLOCK_K": "1024"},
             {"FLUXMPI_TPU_LM_BLOCK_Q": "256", "FLUXMPI_TPU_LM_BLOCK_K": "512"},

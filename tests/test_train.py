@@ -288,3 +288,29 @@ def test_eval_step(world):
     np.testing.assert_allclose(
         float(metrics["mae"]), float(jnp.mean(jnp.abs(pred - y))), rtol=1e-5
     )
+
+
+def test_remat_dots_matches_plain(world):
+    """checkpoint_dots policy must not change the math either."""
+    import optax as _optax  # noqa: F401
+
+    from fluxmpi_tpu.parallel import make_train_step
+    from fluxmpi_tpu.parallel.train import replicate, shard_batch
+
+    model, params, optimizer, state, loss_fn, batch = _setup(world)
+    plain = make_train_step(loss_fn, optimizer, style="auto", donate=False)
+    dots = make_train_step(
+        loss_fn, optimizer, style="auto", donate=False, remat="dots"
+    )
+    s1, l1 = plain(replicate(state), shard_batch(batch))
+    s2, l2 = dots(replicate(state), shard_batch(batch))
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        ),
+        s1.params, s2.params,
+    )
+
+    with pytest.raises(ValueError, match="remat"):
+        make_train_step(loss_fn, optimizer, style="auto", remat="everything")
