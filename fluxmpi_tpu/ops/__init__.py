@@ -6,4 +6,7 @@ from .flash_attention import (  # noqa: F401
     flash_attention_with_lse,
     padding_to_segment_ids,
 )
-from .fused_ce import unembed_cross_entropy  # noqa: F401
+from .fused_ce import (  # noqa: F401
+    tp_unembed_cross_entropy,
+    unembed_cross_entropy,
+)

@@ -35,7 +35,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-__all__ = ["unembed_cross_entropy"]
+__all__ = ["unembed_cross_entropy", "tp_unembed_cross_entropy"]
 
 
 def _tiles(W, chunk: int):
@@ -190,4 +190,174 @@ def unembed_cross_entropy(
     h2 = h.reshape(-1, d)
     targets1 = targets.reshape(-1).astype(jnp.int32)
     out = _fused_ce(h2, embedding, targets1, min(chunk, vocab))
+    return out.reshape(lead)
+
+
+# ---------------------------------------------------------------------------
+# Tensor-parallel (vocab-sharded) spelling — the Megatron parallel CE.
+# ---------------------------------------------------------------------------
+#
+# The custom VJP sits OUTSIDE the shard_map: forward and backward are each
+# one explicit shard_map call over primal values, so no cotangent ever
+# crosses a shard_map boundary — every collective and scale factor below
+# is explicit rather than inherited from transpose rules.
+
+
+def _tp_ce_fwd_body(h2, Wl, targets1, *, chunk, axis_name):
+    """Per-rank forward: local chunked scan over this rank's vocab shard,
+    then pmax+psum combine into the exact global (loss, lse)."""
+    v_local = Wl.shape[0]
+    off0 = jax.lax.axis_index(axis_name) * v_local
+    W3, offsets = _tiles(Wl, chunk)
+    lse_l, t_l = _scan_lse(h2, W3, offsets, targets1 - off0, v_local)
+    m_g = jax.lax.pmax(lse_l, axis_name)
+    lse = m_g + jnp.log(jax.lax.psum(jnp.exp(lse_l - m_g), axis_name))
+    local = targets1 - off0
+    owned = (local >= 0) & (local < v_local)
+    t = jax.lax.psum(jnp.where(owned, t_l, 0.0), axis_name)
+    return lse - t, lse
+
+
+def _tp_ce_bwd_body(h2, Wl, targets1, lse, g, *, chunk, axis_name,
+                    batch_axes):
+    """Per-rank backward: the shared bwd scan computes exactly this
+    shard's contributions when fed the GLOBAL lse and shard-local target
+    ids (p = exp(z_local - lse_global) are true global-softmax columns).
+    dh sums over vocab shards — one psum; with the token dim sharded
+    over ``batch_axes``, dWl additionally sums each shard's per-token
+    contributions over those axes."""
+    v_local = Wl.shape[0]
+    off0 = jax.lax.axis_index(axis_name) * v_local
+    dh_part, dWl, _ = _fused_ce_bwd(
+        chunk, (h2, Wl, targets1 - off0, lse), g
+    )
+    if batch_axes:
+        dWl = jax.lax.psum(dWl, batch_axes)
+    return jax.lax.psum(dh_part, axis_name), dWl
+
+
+def _tp_maps(mesh, axis_name, chunk, batch_axes):
+    from ..parallel._compat import shard_map_unchecked
+
+    from jax.sharding import PartitionSpec as _P
+
+    tok = _P(batch_axes) if batch_axes else _P()
+    tok_h = _P(batch_axes, None) if batch_axes else _P(None, None)
+    fwd = shard_map_unchecked(
+        functools.partial(_tp_ce_fwd_body, chunk=chunk, axis_name=axis_name),
+        mesh,
+        in_specs=(tok_h, _P(axis_name, None), tok),
+        out_specs=(tok, tok),
+    )
+    bwd = shard_map_unchecked(
+        functools.partial(_tp_ce_bwd_body, chunk=chunk, axis_name=axis_name,
+                          batch_axes=batch_axes),
+        mesh,
+        in_specs=(tok_h, _P(axis_name, None), tok, tok, tok),
+        out_specs=(tok_h, _P(axis_name, None)),
+    )
+    return fwd, bwd
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _fused_ce_tp(h2, W, targets1, chunk, axis_name, mesh, batch_axes):
+    return _tp_maps(mesh, axis_name, chunk, batch_axes)[0](h2, W, targets1)[0]
+
+
+def _fused_ce_tp_fwd(h2, W, targets1, chunk, axis_name, mesh, batch_axes):
+    loss, lse = _tp_maps(mesh, axis_name, chunk, batch_axes)[0](
+        h2, W, targets1
+    )
+    return loss, (h2, W, targets1, lse)
+
+
+def _fused_ce_tp_bwd(chunk, axis_name, mesh, batch_axes, res, g):
+    h2, W, targets1, lse = res
+    dh, dW = _tp_maps(mesh, axis_name, chunk, batch_axes)[1](
+        h2, W, targets1, lse, g
+    )
+    return dh, dW, None
+
+
+_fused_ce_tp.defvjp(_fused_ce_tp_fwd, _fused_ce_tp_bwd)
+
+
+def tp_unembed_cross_entropy(
+    h: jnp.ndarray,
+    embedding: jnp.ndarray,
+    targets: jnp.ndarray,
+    *,
+    mesh=None,
+    axis_name: str | None = None,
+    batch_axis_name: str | tuple | None = None,
+    chunk: int = 8192,
+) -> jnp.ndarray:
+    """:func:`unembed_cross_entropy` for a VOCAB-SHARDED embedding table —
+    the Megatron-style parallel cross-entropy.
+
+    Each tensor-parallel rank holds ``[vocab/tp, d]`` of the weight-tied
+    table (the ``transformer_tp_rules`` layout, ``P(tp, None)``) and
+    computes a chunked partial logsumexp plus the target logit for the
+    ids it owns; one ``pmax`` + two ``psum``s combine them into the exact
+    global loss — the full table, the logits, and the gathered softmax
+    never exist anywhere. The backward is local for the table gradient
+    (each rank's shard gradient depends only on its own columns) and one
+    ``psum`` for the hidden-states gradient. Both directions are explicit
+    ``shard_map`` calls under a module-level ``custom_vjp``, so no
+    cotangent depends on shard_map transpose rules.
+
+    Composes inside an auto-sharded jit (``shard_map`` nests under
+    ``jit``): pass the global (sharded) arrays. ``vocab`` must divide
+    evenly by the tp axis size.
+
+    ``batch_axis_name``: mesh axis (or axes) the TOKEN dim is sharded
+    over — pass your dp axis on a dp×tp mesh so every device works on
+    its own token slice instead of replicating the whole batch through
+    the head (the per-shard table gradient then psums over these axes;
+    token count must divide their total extent). Default ``None``
+    replicates the token work across non-tp axes — correct everywhere,
+    wasteful on multi-axis meshes.
+    """
+    from .. import config as _config
+    from ..runtime import global_mesh
+
+    mesh = mesh or global_mesh()
+    tp = axis_name or _config.TP_AXIS_NAME
+    n = mesh.shape.get(tp)
+    if n is None:
+        raise ValueError(f"mesh has no axis {tp!r}")
+    vocab, d = embedding.shape
+    if vocab % n:
+        raise ValueError(
+            f"vocab {vocab} must divide evenly over the {tp!r} axis "
+            f"(size {n}) for the vocab-sharded head"
+        )
+    if h.shape[:-1] != targets.shape:
+        raise ValueError(
+            f"targets shape {targets.shape} must equal the hidden states\' "
+            f"leading shape {h.shape[:-1]}"
+        )
+    if h.shape[-1] != d:
+        raise ValueError(f"hidden dim {h.shape[-1]} != embedding dim {d}")
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    batch_axes = batch_axis_name
+    if isinstance(batch_axes, str):
+        batch_axes = (batch_axes,)
+    if batch_axes:
+        for ax in batch_axes:
+            if ax not in mesh.shape:
+                raise ValueError(f"mesh has no axis {ax!r}")
+            if ax == tp:
+                raise ValueError(
+                    "batch_axis_name cannot include the tp axis"
+                )
+    lead = h.shape[:-1]
+    h2 = h.reshape(-1, d)
+    targets1 = targets.reshape(-1).astype(jnp.int32)
+    local_chunk = min(chunk, vocab // n)
+    out = _fused_ce_tp(
+        h2, embedding, targets1, local_chunk, tp, mesh,
+        tuple(batch_axes) if batch_axes else None,
+    )
     return out.reshape(lead)
