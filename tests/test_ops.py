@@ -1231,3 +1231,39 @@ def test_tp_unembed_ce_with_batch_sharding(world):
     with pytest.raises(ValueError, match="chunk"):
         tp_unembed_cross_entropy(
             h, W, t, mesh=mesh, axis_name="tp", chunk=0)
+
+
+def test_unembed_ce_composes_with_sequence_sharding(world):
+    # SP composition: hidden states sharded over the sequence axis, the
+    # fused CE computed per shard inside shard_map (table replicated) —
+    # per-token losses equal the dense full-sequence oracle.
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from fluxmpi_tpu.ops import unembed_cross_entropy
+
+    try:
+        sm = jax.shard_map
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map as sm
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(8), ("sp",))
+    rng = np.random.default_rng(5)
+    b, s, d, v = 2, 32, 8, 32
+    h = jnp.asarray(rng.normal(size=(b, s, d)).astype(np.float32))
+    W = jnp.asarray(rng.normal(size=(v, d)).astype(np.float32) * 0.3)
+    t = jnp.asarray(rng.integers(0, v, size=(b, s)).astype(np.int32))
+    hs = jax.device_put(h, NamedSharding(mesh, P(None, "sp", None)))
+    ts = jax.device_put(t, NamedSharding(mesh, P(None, "sp")))
+
+    mapped = sm(
+        lambda h, W, t: unembed_cross_entropy(h, W, t, chunk=8),
+        mesh=mesh,
+        in_specs=(P(None, "sp", None), P(), P(None, "sp")),
+        out_specs=P(None, "sp"),
+        check_vma=False,
+    )
+    out = jax.jit(mapped)(hs, W, ts)
+    expected = _ce_oracle(h.reshape(-1, d), W, t.reshape(-1)).reshape(b, s)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               atol=2e-5, rtol=1e-5)
