@@ -31,14 +31,18 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+_KEEP_PLATFORM = False  # set by --allow-cpu (rehearsal mode)
+
+
 def _tpu_env(extra: dict | None = None) -> dict:
     """Child env for TPU work: strip a lingering JAX_PLATFORMS (e.g. cpu
     from the documented CPU-fallback workflow) so children land on the
     axon TPU backend the probe validated — resnet_sweep pins whatever
     JAX_PLATFORMS says, so leaving it set could silently run the headline
-    sweep on CPU while reporting v5e MFU."""
+    sweep on CPU while reporting v5e MFU. Rehearsal mode keeps it."""
     env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)
+    if not _KEEP_PLATFORM:
+        env.pop("JAX_PLATFORMS", None)
     env.update(extra or {})
     return env
 
@@ -46,7 +50,13 @@ def _tpu_env(extra: dict | None = None) -> dict:
 def probe(timeout_s: float = 240.0) -> dict | None:
     """Liveness first: a hung tunnel must not eat the budget."""
     code = (
-        "import jax, jax.numpy as jnp;"
+        "import os, jax;"
+        # An explicit JAX_PLATFORMS (rehearsal mode) must be pinned in
+        # the config too — the sitecustomize's force-registered axon
+        # platform wins over the env var and hangs on a wedged tunnel.
+        "p = os.environ.get('JAX_PLATFORMS');"
+        "p and jax.config.update('jax_platforms', p);"
+        "import jax.numpy as jnp;"
         "d = jax.devices();"
         "x = jnp.ones((256, 256), jnp.bfloat16);"
         "(x @ x).block_until_ready();"
@@ -101,7 +111,13 @@ def main() -> None:
                     help="comma list: resnet,loader,lm,attention")
     ap.add_argument("--trace", action="store_true",
                     help="XPlane-trace the best resnet config")
+    ap.add_argument("--allow-cpu", action="store_true",
+                    help="rehearsal mode: don't abort when the probe "
+                         "lands on CPU (children label their platform)")
     args = ap.parse_args()
+    if args.allow_cpu:
+        global _KEEP_PLATFORM
+        _KEEP_PLATFORM = True
     skip = set(s for s in args.skip.split(",") if s)
     t_start = time.monotonic()
 
@@ -110,18 +126,26 @@ def main() -> None:
 
     p = probe()
     print(json.dumps({"probe": p}), flush=True)
-    if p is None or p.get("platform") == "cpu":
+    if p is None or (p.get("platform") == "cpu" and not args.allow_cpu):
         print(json.dumps({"session": "aborted", "reason": "no live TPU"}),
               flush=True)
         return
 
     report: dict = {"probe": p, "sections": {}}
+    # bench children: "" = use the env default (axon TPU); in rehearsal
+    # pin them to the probe's platform so they can't hang on a wedged
+    # tunnel.
+    bench_platform = p.get("platform", "") if args.allow_cpu else ""
 
     # --- 2. ResNet sweep (the round's #1 ask) -------------------------
     if "resnet" not in skip and remaining() > 900:
+        sweep_args = ["--batches", "128,256,512", "--scan", "1,8"]
+        if args.allow_cpu:
+            # rehearsal sizes: validate orchestration, not the chip
+            sweep_args = ["--quick", "--batches", "2", "--scan", "1,2",
+                          "--image", "32", "--dtype", "float32"]
         r = run_child(
-            [sys.executable, "scripts/resnet_sweep.py",
-             "--batches", "128,256,512", "--scan", "1,8"]
+            [sys.executable, "scripts/resnet_sweep.py", *sweep_args]
             + (["--trace"] if args.trace else []),
             min(2400.0, remaining() - 600),
         )
@@ -135,7 +159,7 @@ def main() -> None:
             (x for x in rows if x.get("mode") == "train" and "mfu" in x),
             key=lambda x: x["mfu"], default=None,
         )
-        env = {"FLUXMPI_TPU_BENCH_PLATFORM": ""}
+        env = {"FLUXMPI_TPU_BENCH_PLATFORM": bench_platform}
         if best:
             env["FLUXMPI_TPU_RESNET_BATCH"] = str(best["batch"])
             if best.get("scan", 1) > 1:
@@ -167,7 +191,7 @@ def main() -> None:
             if remaining() < 240:
                 lm_rows.append({"env": env, "error": "budget exhausted"})
                 break
-            env = {"FLUXMPI_TPU_BENCH_PLATFORM": "", **env}
+            env = {"FLUXMPI_TPU_BENCH_PLATFORM": bench_platform, **env}
             r = run_child(
                 [sys.executable, "bench.py", "--child", "transformer"],
                 min(600.0, remaining() - 60), env,
@@ -182,7 +206,8 @@ def main() -> None:
     if "attention" not in skip and remaining() > 300:
         r = run_child(
             [sys.executable, "bench.py", "--child", "attention"],
-            min(900.0, remaining() - 30), {"FLUXMPI_TPU_BENCH_PLATFORM": ""},
+            min(900.0, remaining() - 30),
+            {"FLUXMPI_TPU_BENCH_PLATFORM": bench_platform},
         )
         report["sections"]["attention"] = r
         print(json.dumps({"attention": r}), flush=True)
