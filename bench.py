@@ -1030,9 +1030,12 @@ def main() -> None:
                 k: attn[k] for k in ("value", "unit", "per_seq")
                 if k in attn
             }
-    if accel_ok and remaining() > 420 and result["metric"] != "bench_failed":
+    # The LM child also runs on the CPU fallback (cheap there): even a
+    # wedged-tunnel round records the fused-CE head's effect.
+    if remaining() > 420 and result["metric"] != "bench_failed":
         lm = _run_child(
-            "transformer", min(480.0, remaining() - 60), probe_platform
+            "transformer", min(480.0, remaining() - 60),
+            probe_platform if accel_ok else "cpu",
         )
         if lm is not None:
             result["transformer_lm"] = {
