@@ -30,4 +30,5 @@ from .resnet import (  # noqa: F401
 )
 from .deq import DEQ, fixed_point_solve  # noqa: F401
 from .transformer import TransformerEncoder, TransformerLM  # noqa: F401
+from .generate import generate  # noqa: F401
 from .vit import ViT  # noqa: F401

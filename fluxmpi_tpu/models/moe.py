@@ -420,6 +420,7 @@ class MoEEncoder(TransformerEncoder):
             dropout=self.dropout,
             dtype=self.dtype,
             attention_fn=self.attention_fn,
+            decode=self.decode,
             num_experts=self.num_experts,
             capacity_factor=self.capacity_factor,
             n_groups=self.n_groups,
@@ -470,6 +471,7 @@ class MoETransformerLM(TransformerLM):
             dropout=self.dropout,
             dtype=self.dtype,
             attention_fn=self.attention_fn,
+            decode=self.decode,
             num_experts=self.num_experts,
             capacity_factor=self.capacity_factor,
             n_groups=self.n_groups,

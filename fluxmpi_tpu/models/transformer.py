@@ -19,6 +19,7 @@ from __future__ import annotations
 from typing import Callable
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 __all__ = ["TransformerEncoder", "TransformerLM"]
@@ -31,6 +32,7 @@ class EncoderBlock(nn.Module):
     dropout: float
     dtype: jnp.dtype
     attention_fn: Callable | None = None
+    decode: bool = False
 
     def make_ff(self) -> nn.Module | None:
         """Hook: return a module for the feed-forward sublayer (called as
@@ -42,7 +44,10 @@ class EncoderBlock(nn.Module):
     @nn.compact
     def __call__(self, x, *, train: bool = True, mask=None):
         attn_kwargs = {}
-        if self.attention_fn is not None:
+        # Autoregressive decoding uses flax's KV cache with the plain
+        # dense single-query attend — a custom attention_fn (flash/ring)
+        # is a training-time kernel and is bypassed at decode.
+        if self.attention_fn is not None and not self.decode:
             attn_kwargs["attention_fn"] = self.attention_fn
         h = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
         h = nn.MultiHeadDotProductAttention(
@@ -50,6 +55,7 @@ class EncoderBlock(nn.Module):
             dtype=self.dtype,
             dropout_rate=self.dropout,
             deterministic=not train,
+            decode=self.decode,
             name="attn",
             **attn_kwargs,
         )(h, h, mask=mask)
@@ -76,6 +82,7 @@ class TransformerEncoder(nn.Module):
     dropout: float = 0.0
     dtype: jnp.dtype = jnp.float32
     attention_fn: Callable | None = None
+    decode: bool = False
 
     def make_block(self, i: int) -> nn.Module:
         """Hook: build encoder block ``i`` (subclasses swap the block type)."""
@@ -86,6 +93,7 @@ class TransformerEncoder(nn.Module):
             dropout=self.dropout,
             dtype=self.dtype,
             attention_fn=self.attention_fn,
+            decode=self.decode,
             name=f"block_{i}",
         )
 
@@ -111,6 +119,7 @@ class TransformerLM(nn.Module):
     dropout: float = 0.0
     dtype: jnp.dtype = jnp.float32
     attention_fn: Callable | None = None
+    decode: bool = False
 
     def make_encoder(self) -> nn.Module:
         """Hook: build the encoder stack (subclasses swap the block type)."""
@@ -122,19 +131,27 @@ class TransformerLM(nn.Module):
             dropout=self.dropout,
             dtype=self.dtype,
             attention_fn=self.attention_fn,
+            decode=self.decode,
             name="encoder",
         )
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = True, targets=None,
-                 loss_chunk: int = 8192):
+                 loss_chunk: int = 8192, pos_offset=None):
         """Returns logits ``[..., vocab]``; or, with ``targets`` (int
         labels, same shape as ``tokens``), the per-token cross-entropy
         losses computed by the chunked fused head
         (:func:`fluxmpi_tpu.ops.unembed_cross_entropy`) — the
         ``[tokens, vocab]`` logits tensor is never materialized, and the
         head matmuls run in the model dtype with f32 accumulation.
-        ``loss_chunk`` tiles the vocab on that path."""
+        ``loss_chunk`` tiles the vocab on that path.
+
+        With ``decode=True`` (autoregressive inference,
+        :func:`fluxmpi_tpu.models.generate`): tokens arrive one position
+        per call, ``pos_offset`` (traced int scalar) selects the position
+        embedding, the attention layers read/extend their flax KV caches
+        (``mutable=["cache"]``), and no causal mask is needed — the cache
+        index provides causality."""
         embed = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype, name="embed")
         pos = self.param(
             "pos_embed",
@@ -142,9 +159,18 @@ class TransformerLM(nn.Module):
             (self.max_len, self.d_model),
         )
         seq = tokens.shape[-1]
-        x = embed(tokens) + pos[:seq][None, :, :].astype(self.dtype)
-        # causal mask
-        mask = nn.make_causal_mask(tokens)
+        if self.decode:
+            if targets is not None:
+                raise ValueError("targets (fused loss) is a training path; "
+                                 "decode=True is inference")
+            offset = 0 if pos_offset is None else pos_offset
+            pos_slice = jax.lax.dynamic_slice_in_dim(pos, offset, seq)
+            x = embed(tokens) + pos_slice[None].astype(self.dtype)
+            mask = None
+        else:
+            x = embed(tokens) + pos[:seq][None, :, :].astype(self.dtype)
+            # causal mask
+            mask = nn.make_causal_mask(tokens)
         x = self.make_encoder()(x, train=train, mask=mask)
         if targets is not None:
             from ..ops import unembed_cross_entropy
