@@ -314,3 +314,50 @@ def test_remat_dots_matches_plain(world):
 
     with pytest.raises(ValueError, match="remat"):
         make_train_step(loss_fn, optimizer, style="auto", remat="everything")
+
+
+def test_scan_steps_composes_with_fsdp_sharding(world):
+    """scan_steps under an FSDP state layout: the scan carry keeps the
+    sharded TrainState layout and the result matches replicated scan."""
+    from jax.sharding import PartitionSpec as P
+
+    from fluxmpi_tpu.models import MLP
+    from fluxmpi_tpu.parallel import (
+        TrainState, fsdp_rule, make_train_step, shard_tree,
+    )
+    from fluxmpi_tpu.parallel.train import replicate, shard_batch
+    import fluxmpi_tpu as fm
+
+    mesh = fm.global_mesh()
+    model = MLP(features=(32, 32, 1))
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 2)))
+    opt = optax.adam(1e-2)
+
+    def loss_fn(p, ms, batch):
+        x, y = batch
+        return jnp.mean((model.apply(p, x) - y) ** 2), ms
+
+    K = 2
+    rng = np.random.default_rng(3)
+    xs = rng.normal(size=(K, 16, 2)).astype(np.float32)
+    ys = rng.normal(size=(K, 16, 1)).astype(np.float32)
+    batch = shard_batch((xs, ys), spec=P(None, "dp"))
+
+    state0 = TrainState.create(params, opt)
+    sharded, shardings = shard_tree(state0, mesh, fsdp_rule(mesh, min_size=8))
+    step_fsdp = make_train_step(
+        loss_fn, opt, mesh=mesh, donate=False, scan_steps=K,
+        state_sharding=shardings,
+    )
+    s1, l1 = step_fsdp(sharded, batch)
+
+    step_rep = make_train_step(loss_fn, opt, mesh=mesh, donate=False,
+                               scan_steps=K)
+    s2, l2 = step_rep(replicate(state0), batch)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        ),
+        jax.device_get(s1.params), jax.device_get(s2.params),
+    )
