@@ -202,6 +202,27 @@ def main() -> None:
             print(json.dumps({"lm": row}), flush=True)
         report["sections"]["lm_sweep"] = lm_rows
 
+    # --- 4b. Band-only kernel compile probe (round-5 windowed flash
+    # ring mode: causal=False + window has only ever compiled in
+    # interpret mode) ---------------------------------------------------
+    if "attention" not in skip and remaining() > 240:
+        code = (
+            "import jax, jax.numpy as jnp, numpy as np;"
+            "from fluxmpi_tpu.ops import flash_attention_with_lse as f;"
+            "q = jnp.ones((2, 256, 4, 64), jnp.bfloat16);"
+            "o, l = f(q, q, q, causal=False, window=64,"
+            " block_q=128, block_k=128);"
+            "g = jax.grad(lambda q: f(q, q, q, causal=False, window=64,"
+            " block_q=128, block_k=128)[0].astype(jnp.float32).sum())(q);"
+            "import json;"
+            "print(json.dumps({'band_kernel': 'ok',"
+            " 'finite': bool(np.isfinite(np.asarray(g, np.float32)).all())}))"
+        )
+        r = run_child([sys.executable, "-c", code],
+                      min(420.0, remaining() - 60))
+        report["sections"]["band_kernel_probe"] = r
+        print(json.dumps({"band_kernel_probe": r}), flush=True)
+
     # --- 5. Attention kernels (r4 layout change never TPU-validated) --
     if "attention" not in skip and remaining() > 300:
         r = run_child(
