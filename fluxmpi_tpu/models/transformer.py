@@ -137,7 +137,8 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = True, targets=None,
-                 loss_chunk: int = 8192, pos_offset=None):
+                 loss_chunk: int = 8192, pos_offset=None,
+                 hidden: bool = False):
         """Returns logits ``[..., vocab]``; or, with ``targets`` (int
         labels, same shape as ``tokens``), the per-token cross-entropy
         losses computed by the chunked fused head
@@ -145,6 +146,13 @@ class TransformerLM(nn.Module):
         ``[tokens, vocab]`` logits tensor is never materialized, and the
         head matmuls run in the model dtype with f32 accumulation.
         ``loss_chunk`` tiles the vocab on that path.
+
+        ``hidden=True`` instead returns ``(hidden_states, embedding)`` —
+        the pre-head ``[..., d_model]`` activations and the tied
+        ``[vocab, d_model]`` table — for composing custom heads, e.g.
+        the vocab-sharded
+        :func:`fluxmpi_tpu.ops.tp_unembed_cross_entropy` under tensor
+        parallelism.
 
         With ``decode=True`` (autoregressive inference,
         :func:`fluxmpi_tpu.models.generate`): tokens arrive one position
@@ -172,6 +180,10 @@ class TransformerLM(nn.Module):
             # causal mask
             mask = nn.make_causal_mask(tokens)
         x = self.make_encoder()(x, train=train, mask=mask)
+        if hidden:
+            if targets is not None:
+                raise ValueError("pass either targets or hidden, not both")
+            return x, embed.embedding
         if targets is not None:
             from ..ops import unembed_cross_entropy
 

@@ -600,3 +600,25 @@ def test_generate_works_with_flash_trained_model(world):
     out = generate(lm, variables, prompt, 5)
     assert out.shape == (1, 8)
     assert np.all((np.asarray(out) >= 0) & (np.asarray(out) < 32))
+
+
+def test_transformer_hidden_escape_hatch(world):
+    # hidden=True exposes (pre-head states, tied table) so custom heads
+    # (e.g. the TP vocab-sharded CE) compose; consistent with logits.
+    from fluxmpi_tpu.models import TransformerLM
+
+    lm = TransformerLM(vocab_size=32, max_len=16, num_layers=1, d_model=16,
+                       num_heads=2, d_ff=32)
+    toks = jnp.zeros((2, 8), jnp.int32)
+    variables = lm.init(jax.random.PRNGKey(0), toks, train=False)
+    h, table = lm.apply(variables, toks, train=False, hidden=True)
+    assert h.shape == (2, 8, 16) and table.shape == (32, 16)
+    logits = lm.apply(variables, toks, train=False)
+    np.testing.assert_allclose(
+        np.asarray(logits),
+        np.asarray(h.astype(jnp.float32) @ table.astype(jnp.float32).T),
+        atol=1e-5,
+    )
+    with pytest.raises(ValueError, match="either targets or hidden"):
+        lm.apply(variables, toks, train=False, hidden=True,
+                 targets=jnp.zeros((2, 8), jnp.int32))
