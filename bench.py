@@ -48,7 +48,10 @@ import numpy as np
 
 # (config name, default child timeout seconds) in fallback order.
 _CONFIGS: tuple[tuple[str, float], ...] = (
-    ("resnet50", 600.0),
+    # A cold-cache ResNet-50 train-step compile can exceed 10 min on the
+    # tunneled chip (persistent cache usually saves this; 900 s covers a
+    # re-provisioned chip with an invalidated cache).
+    ("resnet50", 900.0),
     ("cnn", 300.0),
     ("mlp", 150.0),
 )
