@@ -719,6 +719,34 @@ def _bench_attention():
         "device_kind": jax.devices()[0].device_kind,
         "per_seq": detail,
     }
+
+    # Sliding window at the longest completed seq: the O(seq·window)
+    # tile-skip's measured payoff (window = seq/16, e.g. 512 @ 8192).
+    try:
+        window = max(128, seq // 16)
+        rng = jax.random.PRNGKey(1)
+        kq, kk, kv = jax.random.split(rng, 3)
+        shape = (b, seq, h, d)
+        data = tuple(
+            jax.random.normal(key, shape, jnp.bfloat16)
+            for key in (kq, kk, kv)
+        )
+        win_step = _grad_step(
+            lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                            window=window)
+        )
+        steps = max(4, min(20, (1 << 22) // seq))
+        win_rate, _ = _steps_per_sec(win_step, None, data, 2, steps)
+        result["windowed"] = {
+            "window": window,
+            "seq": seq,
+            "flash_tokens_per_sec": round(b * seq * win_rate, 1),
+            "speedup_vs_causal": (
+                round(win_rate / flash_rate, 3) if flash_rate else None
+            ),
+        }
+    except Exception as exc:  # pragma: no cover - diagnostics only
+        print(f"bench: windowed attention failed: {exc!r}", file=sys.stderr)
     return result
 
 
