@@ -57,21 +57,27 @@ def _col_mask(off, chunk: int, vocab: int):
     return (off + jnp.arange(chunk))[None, :] < vocab
 
 
-def _scan_lse(h2, W3, offsets, targets1, vocab: int):
-    """Shared forward scan: running (m, l, target-logit) over vocab
-    tiles. h2 [N, d]; W3 [K, C, d]; targets1 [N]. Returns (lse [N],
-    t [N]) in f32."""
+def _scan_lse(h2, W3, offsets, targets1, vocab: int,
+              want_zsum: bool = False):
+    """Shared forward scan: running (m, l, target-logit, Σ valid z) over
+    vocab tiles. h2 [N, d]; W3 [K, C, d]; targets1 [N]. Returns
+    (lse [N], t [N], zsum [N]) in f32. The zsum accumulator (which feeds
+    label smoothing) is a STATIC opt-in so the eps=0 program carries no
+    extra per-tile reduction."""
     n = h2.shape[0]
     chunk = W3.shape[1]
 
     def body(carry, xs):
-        m, l, t = carry
+        m, l, t, zsum = carry
         w_c, off = xs
+        mask = _col_mask(off, chunk, vocab)
         z = jax.lax.dot_general(
             h2, w_c.astype(h2.dtype), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [N, C]
-        z = jnp.where(_col_mask(off, chunk, vocab), z, -jnp.inf)
+        if want_zsum:
+            zsum = zsum + jnp.sum(jnp.where(mask, z, 0.0), axis=-1)
+        z = jnp.where(mask, z, -jnp.inf)
         m_new = jnp.maximum(m, jnp.max(z, axis=-1))
         l = l * jnp.exp(m - m_new) + jnp.sum(
             jnp.exp(z - m_new[:, None]), axis=-1
@@ -82,42 +88,54 @@ def _scan_lse(h2, W3, offsets, targets1, vocab: int):
             z, jnp.clip(local, 0, chunk - 1)[:, None], axis=1
         )[:, 0]
         t = jnp.where(in_chunk, picked, t)
-        return (m_new, l, t), None
+        return (m_new, l, t, zsum), None
 
     init = (
         jnp.full((n,), -jnp.inf, jnp.float32),
         jnp.zeros((n,), jnp.float32),
         jnp.zeros((n,), jnp.float32),
+        jnp.zeros((n,), jnp.float32),
     )
-    (m, l, t), _ = jax.lax.scan(body, init, (W3, offsets))
-    return m + jnp.log(l), t
+    (m, l, t, zsum), _ = jax.lax.scan(body, init, (W3, offsets))
+    return m + jnp.log(l), t, zsum
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _fused_ce(h2, W, targets1, chunk):
-    return _fused_ce_fwd(h2, W, targets1, chunk)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _fused_ce(h2, W, targets1, chunk, label_smoothing):
+    return _fused_ce_fwd(h2, W, targets1, chunk, label_smoothing)[0]
 
 
-def _fused_ce_fwd(h2, W, targets1, chunk):
+def _fused_ce_fwd(h2, W, targets1, chunk, label_smoothing):
     W3, offsets = _tiles(W, chunk)
-    lse, t = _scan_lse(h2, W3, offsets, targets1, W.shape[0])
-    return lse - t, (h2, W, targets1, lse)
+    eps = label_smoothing
+    lse, t, zsum = _scan_lse(h2, W3, offsets, targets1, W.shape[0],
+                             want_zsum=bool(eps))
+    # (1-eps)*(lse - t) + eps*(lse - mean_v z) = lse - (1-eps)t - eps*zsum/V
+    loss = lse - (1.0 - eps) * t
+    if eps:
+        loss = loss - eps * zsum / W.shape[0]
+    return loss, (h2, W, targets1, lse)
 
 
-def _fused_ce_bwd(chunk, res, g):
+def _fused_ce_bwd(chunk, label_smoothing, res, g, smooth_vocab=None):
     h2, W, targets1, lse = res
     vocab, d = W.shape
+    # Smoothing spreads eps/V over the GLOBAL vocab — under the TP
+    # spelling the local shard is only vocab/tp of it.
+    v_smooth = vocab if smooth_vocab is None else smooth_vocab
+    eps = label_smoothing
     n = h2.shape[0]
     W3, offsets = _tiles(W, chunk)
     gf = g.astype(jnp.float32)
 
     def body(dh, xs):
         w_c, off = xs
+        mask = _col_mask(off, chunk, vocab)
         z = jax.lax.dot_general(
             h2, w_c.astype(h2.dtype), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [N, C]
-        z = jnp.where(_col_mask(off, chunk, vocab), z, -jnp.inf)
+        z = jnp.where(mask, z, -jnp.inf)
         p = jnp.exp(z - lse[:, None])  # 0 exactly on padded columns
         local = targets1 - off
         in_chunk = (local >= 0) & (local < chunk)
@@ -127,7 +145,12 @@ def _fused_ce_bwd(chunk, res, g):
             )
             * in_chunk[:, None]
         )
-        dz = (p - onehot) * gf[:, None]  # [N, C]
+        # d loss / dz = p - [(1-eps)·onehot + eps/V on valid columns]
+        if eps:
+            target = (1.0 - eps) * onehot + (eps / v_smooth) * mask
+        else:
+            target = onehot
+        dz = (p - target) * gf[:, None]  # [N, C]
         dh = dh + jax.lax.dot_general(
             dz, w_c.astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -154,6 +177,7 @@ def unembed_cross_entropy(
     targets: jnp.ndarray,
     *,
     chunk: int = 8192,
+    label_smoothing: float = 0.0,
 ) -> jnp.ndarray:
     """Per-token ``softmax_cross_entropy(h @ embeddingᵀ, targets)`` without
     materializing the logits.
@@ -168,11 +192,15 @@ def unembed_cross_entropy(
       targets: int labels, shape ``h.shape[:-1]``.
       chunk: vocab tile size; a trailing partial tile is zero-padded and
         masked (never silently shrunk). Peak memory is O(tokens·chunk).
+      label_smoothing: ``eps`` in [0, 1): the target distribution becomes
+        ``(1-eps)·onehot + eps/vocab`` (a running Σz accumulator in the
+        same scan — still no logits tensor).
 
     Returns:
       Per-token losses with shape ``h.shape[:-1]``, f32 — same values as
       ``optax.softmax_cross_entropy_with_integer_labels(h @ embeddingᵀ,
-      targets)`` up to accumulation order.
+      targets)`` (smoothed: ``optax.softmax_cross_entropy`` against the
+      smoothed one-hots) up to accumulation order.
     """
     if h.shape[:-1] != targets.shape:
         raise ValueError(
@@ -186,10 +214,15 @@ def unembed_cross_entropy(
         )
     if chunk < 1:
         raise ValueError(f"chunk must be >= 1, got {chunk}")
+    if not 0.0 <= label_smoothing < 1.0:
+        raise ValueError(
+            f"label_smoothing must be in [0, 1), got {label_smoothing}"
+        )
     lead = h.shape[:-1]
     h2 = h.reshape(-1, d)
     targets1 = targets.reshape(-1).astype(jnp.int32)
-    out = _fused_ce(h2, embedding, targets1, min(chunk, vocab))
+    out = _fused_ce(h2, embedding, targets1, min(chunk, vocab),
+                    float(label_smoothing))
     return out.reshape(lead)
 
 
@@ -203,23 +236,33 @@ def unembed_cross_entropy(
 # is explicit rather than inherited from transpose rules.
 
 
-def _tp_ce_fwd_body(h2, Wl, targets1, *, chunk, axis_name):
+def _tp_ce_fwd_body(h2, Wl, targets1, *, chunk, axis_name,
+                    label_smoothing):
     """Per-rank forward: local chunked scan over this rank's vocab shard,
     then pmax+psum combine into the exact global (loss, lse)."""
     v_local = Wl.shape[0]
     off0 = jax.lax.axis_index(axis_name) * v_local
     W3, offsets = _tiles(Wl, chunk)
-    lse_l, t_l = _scan_lse(h2, W3, offsets, targets1 - off0, v_local)
+    lse_l, t_l, zsum_l = _scan_lse(
+        h2, W3, offsets, targets1 - off0, v_local,
+        want_zsum=bool(label_smoothing),
+    )
     m_g = jax.lax.pmax(lse_l, axis_name)
     lse = m_g + jnp.log(jax.lax.psum(jnp.exp(lse_l - m_g), axis_name))
     local = targets1 - off0
     owned = (local >= 0) & (local < v_local)
     t = jax.lax.psum(jnp.where(owned, t_l, 0.0), axis_name)
-    return lse - t, lse
+    eps = label_smoothing
+    loss = lse - (1.0 - eps) * t
+    if eps:
+        v_global = v_local * jax.lax.psum(1, axis_name)
+        zsum = jax.lax.psum(zsum_l, axis_name)
+        loss = loss - eps * zsum / v_global
+    return loss, lse
 
 
 def _tp_ce_bwd_body(h2, Wl, targets1, lse, g, *, chunk, axis_name,
-                    batch_axes):
+                    batch_axes, label_smoothing):
     """Per-rank backward: the shared bwd scan computes exactly this
     shard's contributions when fed the GLOBAL lse and shard-local target
     ids (p = exp(z_local - lse_global) are true global-softmax columns).
@@ -228,15 +271,17 @@ def _tp_ce_bwd_body(h2, Wl, targets1, lse, g, *, chunk, axis_name,
     contributions over those axes."""
     v_local = Wl.shape[0]
     off0 = jax.lax.axis_index(axis_name) * v_local
+    v_global = v_local * jax.lax.psum(1, axis_name)
     dh_part, dWl, _ = _fused_ce_bwd(
-        chunk, (h2, Wl, targets1 - off0, lse), g
+        chunk, label_smoothing, (h2, Wl, targets1 - off0, lse), g,
+        smooth_vocab=v_global,
     )
     if batch_axes:
         dWl = jax.lax.psum(dWl, batch_axes)
     return jax.lax.psum(dh_part, axis_name), dWl
 
 
-def _tp_maps(mesh, axis_name, chunk, batch_axes):
+def _tp_maps(mesh, axis_name, chunk, batch_axes, label_smoothing):
     from ..parallel._compat import shard_map_unchecked
 
     from jax.sharding import PartitionSpec as _P
@@ -244,14 +289,16 @@ def _tp_maps(mesh, axis_name, chunk, batch_axes):
     tok = _P(batch_axes) if batch_axes else _P()
     tok_h = _P(batch_axes, None) if batch_axes else _P(None, None)
     fwd = shard_map_unchecked(
-        functools.partial(_tp_ce_fwd_body, chunk=chunk, axis_name=axis_name),
+        functools.partial(_tp_ce_fwd_body, chunk=chunk, axis_name=axis_name,
+                          label_smoothing=label_smoothing),
         mesh,
         in_specs=(tok_h, _P(axis_name, None), tok),
         out_specs=(tok, tok),
     )
     bwd = shard_map_unchecked(
         functools.partial(_tp_ce_bwd_body, chunk=chunk, axis_name=axis_name,
-                          batch_axes=batch_axes),
+                          batch_axes=batch_axes,
+                          label_smoothing=label_smoothing),
         mesh,
         in_specs=(tok_h, _P(axis_name, None), tok, tok, tok),
         out_specs=(tok_h, _P(axis_name, None)),
@@ -259,23 +306,25 @@ def _tp_maps(mesh, axis_name, chunk, batch_axes):
     return fwd, bwd
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _fused_ce_tp(h2, W, targets1, chunk, axis_name, mesh, batch_axes):
-    return _tp_maps(mesh, axis_name, chunk, batch_axes)[0](h2, W, targets1)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _fused_ce_tp(h2, W, targets1, chunk, axis_name, mesh, batch_axes,
+                 label_smoothing):
+    return _tp_maps(mesh, axis_name, chunk, batch_axes,
+                    label_smoothing)[0](h2, W, targets1)[0]
 
 
-def _fused_ce_tp_fwd(h2, W, targets1, chunk, axis_name, mesh, batch_axes):
-    loss, lse = _tp_maps(mesh, axis_name, chunk, batch_axes)[0](
-        h2, W, targets1
-    )
+def _fused_ce_tp_fwd(h2, W, targets1, chunk, axis_name, mesh, batch_axes,
+                     label_smoothing):
+    loss, lse = _tp_maps(mesh, axis_name, chunk, batch_axes,
+                         label_smoothing)[0](h2, W, targets1)
     return loss, (h2, W, targets1, lse)
 
 
-def _fused_ce_tp_bwd(chunk, axis_name, mesh, batch_axes, res, g):
+def _fused_ce_tp_bwd(chunk, axis_name, mesh, batch_axes, label_smoothing,
+                     res, g):
     h2, W, targets1, lse = res
-    dh, dW = _tp_maps(mesh, axis_name, chunk, batch_axes)[1](
-        h2, W, targets1, lse, g
-    )
+    dh, dW = _tp_maps(mesh, axis_name, chunk, batch_axes,
+                      label_smoothing)[1](h2, W, targets1, lse, g)
     return dh, dW, None
 
 
@@ -291,9 +340,11 @@ def tp_unembed_cross_entropy(
     axis_name: str | None = None,
     batch_axis_name: str | tuple | None = None,
     chunk: int = 8192,
+    label_smoothing: float = 0.0,
 ) -> jnp.ndarray:
     """:func:`unembed_cross_entropy` for a VOCAB-SHARDED embedding table —
-    the Megatron-style parallel cross-entropy.
+    the Megatron-style parallel cross-entropy (``label_smoothing``
+    supported: the Σz term psums across vocab shards).
 
     Each tensor-parallel rank holds ``[vocab/tp, d]`` of the weight-tied
     table (the ``transformer_tp_rules`` layout, ``P(tp, None)``) and
@@ -355,9 +406,13 @@ def tp_unembed_cross_entropy(
     lead = h.shape[:-1]
     h2 = h.reshape(-1, d)
     targets1 = targets.reshape(-1).astype(jnp.int32)
+    if not 0.0 <= label_smoothing < 1.0:
+        raise ValueError(
+            f"label_smoothing must be in [0, 1), got {label_smoothing}"
+        )
     local_chunk = min(chunk, vocab // n)
     out = _fused_ce_tp(
         h2, embedding, targets1, local_chunk, tp, mesh,
-        tuple(batch_axes) if batch_axes else None,
+        tuple(batch_axes) if batch_axes else None, float(label_smoothing),
     )
     return out.reshape(lead)

@@ -1267,3 +1267,72 @@ def test_unembed_ce_composes_with_sequence_sharding(world):
     expected = _ce_oracle(h.reshape(-1, d), W, t.reshape(-1)).reshape(b, s)
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
                                atol=2e-5, rtol=1e-5)
+
+
+def test_unembed_ce_label_smoothing_matches_dense(world):
+    # Smoothed target distribution (1-eps)*onehot + eps/V: values AND
+    # both gradients vs optax's soft-label CE, including a padded tile.
+    import optax
+
+    from fluxmpi_tpu.ops import unembed_cross_entropy
+
+    rng = np.random.default_rng(6)
+    n, d, v, eps = 12, 8, 20, 0.1
+    h = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+    W = jnp.asarray(rng.normal(size=(v, d)).astype(np.float32) * 0.3)
+    t = jnp.asarray(rng.integers(0, v, size=(n,)).astype(np.int32))
+
+    def dense(h, W):
+        logits = h @ W.T
+        soft = (1 - eps) * jax.nn.one_hot(t, v) + eps / v
+        return optax.softmax_cross_entropy(logits, soft)
+
+    def fused(h, W):
+        return unembed_cross_entropy(h, W, t, chunk=8, label_smoothing=eps)
+
+    np.testing.assert_allclose(np.asarray(fused(h, W)),
+                               np.asarray(dense(h, W)),
+                               atol=2e-5, rtol=1e-5)
+    gf = jax.grad(lambda h, W: jnp.mean(fused(h, W)), argnums=(0, 1))(h, W)
+    gd = jax.grad(lambda h, W: jnp.mean(dense(h, W)), argnums=(0, 1))(h, W)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=1e-4)
+
+    with pytest.raises(ValueError, match="label_smoothing"):
+        unembed_cross_entropy(h, W, t, label_smoothing=1.0)
+
+
+def test_tp_unembed_ce_label_smoothing_matches_dense(world):
+    import optax
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from fluxmpi_tpu.ops import tp_unembed_cross_entropy
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(8), ("tp",))
+    rng = np.random.default_rng(7)
+    n, d, v, eps = 8, 8, 32, 0.2
+    h = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+    W = jnp.asarray(rng.normal(size=(v, d)).astype(np.float32) * 0.3)
+    t = jnp.asarray(rng.integers(0, v, size=(n,)).astype(np.int32))
+    Ws = jax.device_put(W, NamedSharding(mesh, P("tp", None)))
+
+    def dense(h, W):
+        soft = (1 - eps) * jax.nn.one_hot(t, v) + eps / v
+        return optax.softmax_cross_entropy(h @ W.T, soft)
+
+    def fused(h, W):
+        return tp_unembed_cross_entropy(
+            h, W, t, mesh=mesh, axis_name="tp", chunk=4,
+            label_smoothing=eps)
+
+    np.testing.assert_allclose(np.asarray(jax.jit(fused)(h, Ws)),
+                               np.asarray(dense(h, W)),
+                               atol=2e-5, rtol=1e-5)
+    gf = jax.jit(jax.grad(lambda h, W: jnp.mean(fused(h, W)),
+                          argnums=(0, 1)))(h, Ws)
+    gd = jax.grad(lambda h, W: jnp.mean(dense(h, W)), argnums=(0, 1))(h, W)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=1e-4)
