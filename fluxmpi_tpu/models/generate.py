@@ -51,6 +51,8 @@ def generate(
     max_new_tokens: int,
     *,
     temperature: float = 0.0,
+    top_k: int | None = None,
+    eos_token: int | None = None,
     rng: jax.Array | None = None,
 ) -> jnp.ndarray:
     """Generate ``max_new_tokens`` continuations of ``prompt``.
@@ -64,6 +66,11 @@ def generate(
         must fit ``model.max_len``.
       temperature: 0 = greedy argmax; > 0 = softmax sampling at that
         temperature (requires ``rng``).
+      top_k: with sampling, restrict to the k highest-probability tokens
+        before drawing.
+      eos_token: once a row emits this token, every later position in
+        that row is forced to it (shapes stay static; the scan still
+        runs ``max_new_tokens`` ticks).
 
     Returns:
       int32 ``[batch, prompt_len + max_new_tokens]`` — the prompt
@@ -82,6 +89,8 @@ def generate(
         raise ValueError(f"temperature must be >= 0, got {temperature}")
     if temperature > 0 and rng is None:
         raise ValueError("temperature > 0 requires an rng key")
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
     if rng is None:
         rng = jax.random.PRNGKey(0)
 
@@ -102,7 +111,7 @@ def generate(
     prompt = prompt.astype(jnp.int32)
 
     def body(carry, _):
-        cache, tok, pos, rng = carry
+        cache, tok, pos, rng, done = carry
         logits, mutated = twin.apply(
             {"params": params["params"], "cache": cache},
             tok, train=False, pos_offset=pos, mutable=["cache"],
@@ -110,6 +119,9 @@ def generate(
         logits = logits[:, -1]  # [b, vocab]
         rng, sub = jax.random.split(rng)
         if temperature > 0:
+            if top_k is not None and top_k < logits.shape[-1]:
+                kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+                logits = jnp.where(logits < kth, -jnp.inf, logits)
             nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
         else:
             nxt = jnp.argmax(logits, axis=-1)
@@ -120,9 +132,13 @@ def generate(
             prompt, jnp.minimum(pos + 1, plen - 1), 1, axis=1
         )[:, 0]
         nxt = jnp.where(in_prompt, forced, nxt).astype(jnp.int32)
-        return (mutated["cache"], nxt[:, None], pos + 1, rng), nxt
+        if eos_token is not None:
+            nxt = jnp.where(done, jnp.int32(eos_token), nxt)
+            done = done | ((nxt == eos_token) & jnp.logical_not(in_prompt))
+        return (mutated["cache"], nxt[:, None], pos + 1, rng, done), nxt
 
-    init = (cache, prompt[:, :1], jnp.asarray(0), rng)
+    init = (cache, prompt[:, :1], jnp.asarray(0), rng,
+            jnp.zeros((b,), bool))
     _, toks = jax.lax.scan(body, init, None, length=total - 1)
     # toks: [total-1, b] — tokens for positions 1..total-1.
     return jnp.concatenate([prompt[:, :1], toks.T], axis=1)

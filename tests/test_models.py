@@ -622,3 +622,33 @@ def test_transformer_hidden_escape_hatch(world):
     with pytest.raises(ValueError, match="either targets or hidden"):
         lm.apply(variables, toks, train=False, hidden=True,
                  targets=jnp.zeros((2, 8), jnp.int32))
+
+
+def test_generate_eos_and_top_k(world):
+    from fluxmpi_tpu.models import TransformerLM, generate
+
+    lm = TransformerLM(vocab_size=16, max_len=20, num_layers=1, d_model=16,
+                       num_heads=2, d_ff=32)
+    prompt = jnp.zeros((2, 3), jnp.int32)
+    variables = lm.init(jax.random.PRNGKey(0), prompt, train=False)
+
+    # Greedy with eos = whatever the model emits first: everything after
+    # the first occurrence must be eos too.
+    free = np.asarray(generate(lm, variables, prompt, 8))
+    eos = int(free[0, 3])
+    out = np.asarray(generate(lm, variables, prompt, 8, eos_token=eos))
+    for row in out:
+        hits = np.where(row[3:] == eos)[0]
+        if hits.size:
+            assert np.all(row[3 + hits[0]:] == eos)
+
+    # top_k=1 sampling == greedy regardless of temperature.
+    topk1 = np.asarray(generate(lm, variables, prompt, 8, temperature=2.0,
+                                top_k=1, rng=jax.random.PRNGKey(3)))
+    np.testing.assert_array_equal(topk1, free)
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="top_k"):
+        generate(lm, variables, prompt, 4, temperature=1.0, top_k=0,
+                 rng=jax.random.PRNGKey(0))
