@@ -22,7 +22,12 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 import time
+
+# Runs from a source checkout: `python scripts/resnet_sweep.py` puts
+# scripts/ (not the repo root) at sys.path[0].
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 

@@ -43,6 +43,12 @@ def _tpu_env(extra: dict | None = None) -> dict:
     env = dict(os.environ)
     if not _KEEP_PLATFORM:
         env.pop("JAX_PLATFORMS", None)
+    # The package lives in a source checkout; children launched from
+    # scripts/ (resnet_sweep) need the repo root on their import path
+    # even when the launcher's shell never exported PYTHONPATH.
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (REPO, env.get("PYTHONPATH")) if p
+    )
     env.update(extra or {})
     return env
 
@@ -124,8 +130,20 @@ def main() -> None:
     def remaining() -> float:
         return args.budget - (time.monotonic() - t_start)
 
-    p = probe()
-    print(json.dumps({"probe": p}), flush=True)
+    # The axon tunnel releases its exclusive lease minutes after the
+    # previous holder exits; a single CPU-fallback probe right after a
+    # kill is a race, not an outage. Retry with backoff before giving up.
+    p = None
+    for attempt in range(5):
+        p = probe()
+        print(json.dumps({"probe": p, "attempt": attempt}), flush=True)
+        if p is None:
+            break  # hung-probe timeout = wedged tunnel: bail fast
+        if args.allow_cpu or p.get("platform") != "cpu":
+            break
+        if attempt == 4 or remaining() < 600:
+            break
+        time.sleep(120)
     if p is None or (p.get("platform") == "cpu" and not args.allow_cpu):
         print(json.dumps({"session": "aborted", "reason": "no live TPU"}),
               flush=True)

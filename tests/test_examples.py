@@ -55,6 +55,12 @@ def test_every_example_is_covered():
 )
 def test_example_runs(name, argv, sentinel, timeout):
     env = dict(os.environ)
+    # The package is used from a source checkout (never pip-installed in
+    # this image); examples import it by name, so the child needs the
+    # repo root on its path regardless of the launcher's environment.
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (str(_REPO), env.get("PYTHONPATH")) if p
+    )
     # Examples without a --simulate flag pin themselves; for the rest the
     # flag sets both env vars before importing jax. Either way the
     # subprocess must never touch a real accelerator from the test suite.
