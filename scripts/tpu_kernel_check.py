@@ -1,0 +1,175 @@
+"""On-hardware correctness check for the Pallas flash-attention kernels.
+
+The test suite pins the kernels to CPU interpret mode (conftest), so
+until a chip is attached the compiled Mosaic lowering itself is never
+exercised. This script runs the forward AND both backward kernels on the
+real TPU against the dense oracle (same segment semantics as the suite's
+``tests/_oracles.py``) across the feature matrix: plain / causal /
+windowed / segmented / GQA, in f32 (tight tolerance) and bf16
+(production dtype, loose tolerance), plus in-kernel dropout determinism
+and keep-rate sanity.
+
+Usage:  python scripts/tpu_kernel_check.py   (one JSON line per case)
+Exit code 1 if any case fails its tolerance.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import zlib
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+import jax
+
+# The axon sitecustomize force-registers the TPU platform (jax_platforms
+# becomes "axon,cpu") and WINS over the env var; honoring JAX_PLATFORMS
+# here keeps a CPU rehearsal from dialing (and hanging on) a leased TPU.
+# A rehearsal (--allow-cpu) must never touch the tunnel at all.
+_p = os.environ.get("JAX_PLATFORMS") or (
+    "cpu" if "--allow-cpu" in sys.argv else None
+)
+if _p:
+    jax.config.update("jax_platforms", _p)
+
+import jax.numpy as jnp
+import numpy as np
+
+from fluxmpi_tpu.ops import flash_attention
+from fluxmpi_tpu.ops.flash_attention import padding_to_segment_ids
+
+
+sys.path.insert(0, os.path.join(_ROOT, "tests"))
+from _oracles import dense_seg_attention  # noqa: E402  (suite's single source)
+
+
+def dense_oracle(q, k, v, qseg, kseg, causal=False, window=None):
+    # The suite's oracle (single source for segment-mask semantics), plus
+    # a GQA kv-head repeat and an f32 upcast for tight comparison.
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return dense_seg_attention(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
+        qseg, kseg, causal=causal, window=window,
+    )
+
+
+_INTERPRET = False  # rehearsal mode (--allow-cpu): interpret-mode kernels
+
+
+def run_case(name, *, seq=512, h=8, h_kv=None, d=64, causal=False,
+             window=None, segments=False, dtype=jnp.float32, tol=2e-3):
+    key = jax.random.PRNGKey(zlib.crc32(name.encode()) % (2**31))
+    kq, kk, kv, kc, ks = jax.random.split(key, 5)
+    b = 2
+    h_kv = h_kv or h
+    q = jax.random.normal(kq, (b, seq, h, d), dtype)
+    k = jax.random.normal(kk, (b, seq, h_kv, d), dtype)
+    v = jax.random.normal(kv, (b, seq, h_kv, d), dtype)
+    cot = jax.random.normal(kc, (b, seq, h, d), jnp.float32)
+    if segments:
+        lengths = jax.random.randint(ks, (b,), seq // 2, seq)
+        seg = padding_to_segment_ids(jnp.arange(seq)[None, :] < lengths[:, None])
+        valid = (seg != 0).astype(jnp.float32)[:, :, None, None]
+    else:
+        seg = jnp.ones((b, seq), jnp.int32)
+        valid = jnp.ones((b, seq, 1, 1), jnp.float32)
+
+    def flash_loss(q, k, v):
+        o = flash_attention(q, k, v, causal=causal, window=window,
+                            segment_ids=seg if segments else None,
+                            interpret=_INTERPRET)
+        return jnp.sum(o.astype(jnp.float32) * cot * valid), o
+
+    def dense_loss(q, k, v):
+        o = dense_oracle(q, k, v, seg, seg, causal=causal, window=window)
+        return jnp.sum(o * cot * valid), o
+
+    (_, o_f), g_f = jax.value_and_grad(flash_loss, (0, 1, 2),
+                                       has_aux=True)(q, k, v)
+    (_, o_d), g_d = jax.value_and_grad(dense_loss, (0, 1, 2),
+                                       has_aux=True)(q, k, v)
+    errs = {"out": float(jnp.max(jnp.abs(o_f.astype(jnp.float32) - o_d)
+                                 * valid))}
+    for nm, a, bb in zip(("dq", "dk", "dv"), g_f, g_d):
+        errs[nm] = float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                         - bb.astype(jnp.float32))))
+    ok = all(e <= tol for e in errs.values())
+    print(json.dumps({"case": name, "dtype": str(dtype.__name__ if hasattr(
+        dtype, "__name__") else dtype), "ok": ok, "tol": tol,
+        "max_abs_err": errs}), flush=True)
+    return ok
+
+
+def run_dropout_case():
+    key = jax.random.PRNGKey(7)
+    kq, kk, kv = jax.random.split(key, 3)
+    b, seq, h, d = 2, 512, 4, 64
+    q = jax.random.normal(kq, (b, seq, h, d))
+    k = jax.random.normal(kk, (b, seq, h, d))
+    v = jax.random.normal(kv, (b, seq, h, d))
+    rate = 0.25
+    seed = jnp.uint32(123)
+
+    def att(s):
+        return flash_attention(q, k, v, causal=True, dropout_rate=rate,
+                               dropout_seed=s, interpret=_INTERPRET)
+
+    o1, o2, o3 = att(seed), att(seed), att(jnp.uint32(456))
+    deterministic = bool(jnp.array_equal(o1, o2))
+    differs = bool(jnp.any(o1 != o3))
+    o0 = flash_attention(q, k, v, causal=True, interpret=_INTERPRET)
+    # With 1/keep scaling the mean magnitude is preserved in expectation;
+    # a dropped-prob output differs from the no-dropout one almost surely.
+    changed_frac = float(jnp.mean((o1 != o0).astype(jnp.float32)))
+    ratio = float(jnp.mean(jnp.abs(o1)) / jnp.mean(jnp.abs(o0)))
+    ok = deterministic and differs and changed_frac > 0.5 \
+        and 0.8 < ratio < 1.3
+    print(json.dumps({"case": "dropout", "ok": ok,
+                      "deterministic": deterministic,
+                      "seed_sensitivity": differs,
+                      "changed_frac": round(changed_frac, 4),
+                      "mean_abs_ratio": round(ratio, 4)}), flush=True)
+    return ok
+
+
+def main():
+    global _INTERPRET
+    if "--allow-cpu" in sys.argv:
+        _INTERPRET = True
+    quick = "--quick" in sys.argv  # plumbing rehearsal (interpret is slow)
+    dev = jax.devices()[0]
+    print(json.dumps({"platform": dev.platform,
+                      "kind": dev.device_kind}), flush=True)
+    if dev.platform != "tpu" and not _INTERPRET:
+        print(json.dumps({"aborted": "not a TPU"}), flush=True)
+        sys.exit(2)
+    ok = True
+    if quick:
+        ok &= run_case("causal_f32", seq=256, causal=True)
+        ok &= run_case("seg_gqa_window_f32", seq=256, segments=True,
+                       causal=True, window=128, h_kv=2)
+    else:
+        ok &= run_case("plain_f32")
+        ok &= run_case("causal_f32", causal=True)
+        ok &= run_case("window_f32", causal=True, window=128)
+        ok &= run_case("segments_f32", segments=True)
+        ok &= run_case("gqa_causal_f32", causal=True, h_kv=2)
+        ok &= run_case("causal_bf16", causal=True, dtype=jnp.bfloat16,
+                       tol=3e-2)
+        ok &= run_case("gqa_window_bf16", causal=True, window=128, h_kv=2,
+                       dtype=jnp.bfloat16, tol=3e-2)
+        ok &= run_case("long_causal_bf16", seq=2048, causal=True,
+                       dtype=jnp.bfloat16, tol=3e-2)
+        ok &= run_dropout_case()
+    print(json.dumps({"all_ok": bool(ok)}), flush=True)
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -90,24 +90,42 @@ def run_child(argv: list[str], timeout_s: float, env: dict | None = None):
     cheap)."""
     full_env = _tpu_env(env)
     t0 = time.time()
+    timed_out = False
     try:
         proc = subprocess.run(
             argv, capture_output=True, text=True, timeout=timeout_s,
             env=full_env, cwd=REPO,
         )
-    except subprocess.TimeoutExpired:
-        return {"argv": argv[-2:], "error": f"timeout {timeout_s}s"}
+        stdout, stderr, rc = proc.stdout, proc.stderr, proc.returncode
+    except subprocess.TimeoutExpired as e:
+        # Children emit one JSON line per finished measurement; a timeout
+        # must salvage the lines that completed, not discard the run.
+        timed_out = True
+        stdout = (e.stdout or b"")
+        stderr = (e.stderr or b"")
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode(errors="replace")
+        if isinstance(stderr, bytes):
+            stderr = stderr.decode(errors="replace")
+        rc = -1
     out = []
-    for line in proc.stdout.strip().splitlines():
+    for line in (stdout or "").strip().splitlines():
         try:
             out.append(json.loads(line))
         except json.JSONDecodeError:
             pass
-    if proc.returncode != 0 and not out:
-        return {"argv": argv[-2:],
-                "error": (proc.stderr or "")[-300:],
-                "wall_s": round(time.time() - t0, 1)}
-    return {"results": out, "wall_s": round(time.time() - t0, 1)}
+    res: dict = {"results": out, "wall_s": round(time.time() - t0, 1)}
+    if timed_out:
+        res["error"] = f"timeout {timeout_s}s (partial results salvaged)"
+        if stderr:
+            res["stderr_tail"] = stderr[-300:]
+    elif rc != 0:
+        if not out:
+            return {"argv": argv[-2:], "error": (stderr or "")[-300:],
+                    "wall_s": round(time.time() - t0, 1)}
+        res["rc"] = rc  # crashed after emitting rows: partial, not clean
+        res["stderr_tail"] = (stderr or "")[-300:]
+    return res
 
 
 def main() -> None:
