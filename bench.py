@@ -16,9 +16,16 @@ the fixed sync cost cancels exactly.
 
 Probe design (round-2 verdict #1): the liveness probe tries platform
 variants in order (env default → ``JAX_PLATFORMS=''`` auto-choice →
-explicit ``tpu``) with per-attempt timeouts 120/240/300 s
+explicit ``tpu``) with per-attempt timeouts 120/300/1800 s
 (env-overridable), and every attempt's outcome lands in the output JSON
 under ``probe`` so a dead chip is distinguishable from a harness bug.
+The final 1800 s attempt exists because of the axon lease semantics
+measured in round 5 (BENCH_NOTES_r05.md): after any client is killed
+uncleanly, the next backend init BLOCKS for the server-side lease TTL —
+~1500 s, reproduced three times to within 1 s — then succeeds. A probe
+ladder capped at 300 s concludes "dead chip" for what is actually a
+25-minute queue behind a stale lease; one attempt must outlast the TTL.
+(Clean client exits hand the lease off in seconds; only kills arm it.)
 
 ``vs_baseline``: the reference publishes no numbers (BASELINE.md
 "published: {}"), so the ratio is against this repo's own recorded anchor,
@@ -29,7 +36,9 @@ Env knobs:
   FLUXMPI_TPU_BENCH_CONFIG    force one config
                               (resnet50|cnn|mlp|attention|transformer|deq)
   FLUXMPI_TPU_BENCH_TIMEOUT   override per-config child timeout in seconds
-  FLUXMPI_TPU_BENCH_BUDGET    overall wall budget in seconds (default 1500)
+  FLUXMPI_TPU_BENCH_BUDGET    overall wall budget in seconds (default 4200;
+                              sized so the 1800 s lease-TTL probe attempt
+                              still leaves the headline child its 900 s)
   FLUXMPI_TPU_BENCH_PLATFORM  pin jax_platforms in children (e.g. "cpu")
   FLUXMPI_TPU_BENCH_PROBE_TIMEOUTS  comma list of probe timeouts (s)
   FLUXMPI_TPU_BENCH_DEVICES   child uses only the first N devices
@@ -55,7 +64,13 @@ _CONFIGS: tuple[tuple[str, float], ...] = (
     ("cnn", 300.0),
     ("mlp", 150.0),
 )
-_DEFAULT_PROBE_TIMEOUTS = (120.0, 240.0, 300.0)
+# 120/300 catch a healthy or cleanly-handed-off tunnel; the 1800 s final
+# attempt outlasts the ~1500 s stale-lease TTL (see module docstring) so a
+# chip queued behind a killed client is recovered instead of reported dead.
+# 1800 (not 1500+epsilon): the measured 1501-1502 s waits exclude child
+# interpreter start + jax import, and killing a probe child at the moment
+# it finally acquires the lease would re-arm the TTL for the next client.
+_DEFAULT_PROBE_TIMEOUTS = (120.0, 300.0, 1800.0)
 # Platform variant tried at each probe attempt: None = leave the env alone,
 # "" = JAX_PLATFORMS='' (let jax auto-pick — round 1's own error message
 # suggested exactly this), "tpu" = demand the TPU backend.
@@ -969,7 +984,7 @@ def _run_scaling(
 
 def main() -> None:
     t_start = time.monotonic()
-    budget = float(os.environ.get("FLUXMPI_TPU_BENCH_BUDGET", "1500"))
+    budget = float(os.environ.get("FLUXMPI_TPU_BENCH_BUDGET", "4200"))
 
     def remaining() -> float:
         return budget - (time.monotonic() - t_start)

@@ -54,7 +54,15 @@ def _tpu_env(extra: dict | None = None) -> dict:
 
 
 def probe(timeout_s: float = 240.0) -> dict | None:
-    """Liveness first: a hung tunnel must not eat the budget."""
+    """Liveness first: a hung tunnel must not eat the budget.
+
+    Caveat measured in round 5 (BENCH_NOTES_r05.md): after an UNCLEAN
+    client kill the next backend init blocks ~1500 s (server lease TTL)
+    and then succeeds, so a short probe timeout right after a kill reads
+    as "dead" when the chip is merely queued. Callers recovering from a
+    kill should pass timeout_s > 1560. Corollary: this script's own
+    run_child timeouts are the kill mechanism that arms that TTL — size
+    child budgets so children finish by themselves whenever possible."""
     code = (
         "import os, jax;"
         # An explicit JAX_PLATFORMS (rehearsal mode) must be pinned in
@@ -156,7 +164,16 @@ def main() -> None:
         p = probe()
         print(json.dumps({"probe": p, "attempt": attempt}), flush=True)
         if p is None:
-            break  # hung-probe timeout = wedged tunnel: bail fast
+            # Hung probe: either a truly dead tunnel OR a chip queued
+            # behind a stale lease (~1500 s TTL after an unclean kill —
+            # see probe()'s docstring). One attempt must outlast the TTL
+            # before we may conclude "dead"; only do it if the budget
+            # survives the wait.
+            if remaining() > 1800 + 900:
+                p = probe(timeout_s=1800.0)
+                print(json.dumps({"probe": p, "attempt": "lease-ttl"}),
+                      flush=True)
+            break
         if args.allow_cpu or p.get("platform") != "cpu":
             break
         if attempt == 4 or remaining() < 600:

@@ -10,7 +10,13 @@ cd "$(dirname "$0")/.." || exit 1
 rm -f "$OK"
 for i in $(seq 1 60); do
   echo "[$(date -u +%H:%M:%S)] probe attempt $i" >> "$LOG"
-  timeout 300 python -u -c "
+  # Every 4th attempt probes long enough (1800 s) to outlast the ~1500 s
+  # stale-lease TTL (BENCH_NOTES_r05.md): after an unclean client kill,
+  # backend init BLOCKS ~25 min then succeeds — a 300 s probe would call
+  # that chip dead forever, and its own SIGKILL re-arms the TTL.
+  PROBE_TIMEOUT=300
+  if [ $((i % 4)) -eq 0 ]; then PROBE_TIMEOUT=1800; fi
+  timeout $PROBE_TIMEOUT python -u -c "
 import jax, jax.numpy as jnp
 d = jax.devices()
 x = jnp.ones((256,256), jnp.bfloat16)
