@@ -7,8 +7,10 @@
 5. :class:`TransformerEncoder` — the wrapped-model adapter path.
 
 Beyond the five parity configs: ResNet-18/34/101, :class:`TransformerLM`,
-Switch-MoE variants, and :class:`ViT` (patch-conv + the same encoder
-stack; composes with the flash/ring/Ulysses ``attention_fn`` hooks).
+Switch-MoE variants, :class:`ViT` (patch-conv + the same encoder stack;
+composes with the flash/ring/Ulysses ``attention_fn`` hooks), and
+:class:`UNet` with the DDPM/DDIM helpers (generative vision — GroupNorm
+conv stages + spatial self-attention on the same ``attention_fn`` hook).
 """
 
 from .mlp import MLP  # noqa: F401
@@ -32,3 +34,9 @@ from .deq import DEQ, fixed_point_solve  # noqa: F401
 from .transformer import TransformerEncoder, TransformerLM  # noqa: F401
 from .generate import generate  # noqa: F401
 from .vit import ViT  # noqa: F401
+from .unet import (  # noqa: F401
+    UNet,
+    cosine_beta_schedule,
+    ddim_sample,
+    ddpm_loss,
+)

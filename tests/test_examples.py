@@ -32,6 +32,8 @@ _CASES = [
     ("adapter_sync.py", ["--simulate", "8"], "ADAPTER_SYNC_OK"),
     # Trains to convergence (the generation check needs a sharp model).
     ("lm_pretrain.py", ["--simulate", "8"], "LM_PRETRAIN_OK", 900),
+    ("ddpm_toy.py", ["--simulate", "8", "--steps", "60"], "DDPM_TOY_OK",
+     600),
     ("parallelism_3d.py", [], "PARALLELISM_3D_OK"),
     ("long_context_zigzag.py", [], "LONG_CONTEXT_ZIGZAG_OK"),
 ]

@@ -1,0 +1,192 @@
+"""UNet/DDPM family: forward shapes, schedule invariants, a DP train step
+on the 8-device mesh, the compiled DDIM sampler, and the attention_fn
+hook parity with the zoo's transformers."""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+def _tiny_unet(**kw):
+    from fluxmpi_tpu.models import UNet
+
+    cfg = dict(out_channels=3, base_channels=8, channel_mults=(1, 2),
+               blocks_per_stage=1, attn_resolutions=(8,), num_heads=2,
+               groups=4)
+    cfg.update(kw)
+    return UNet(**cfg)
+
+
+def test_unet_forward_shape(world):
+    model = _tiny_unet()
+    x = jnp.ones((2, 16, 16, 3))
+    t = jnp.array([0, 9], jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x, t)
+    out = model.apply(params, x, t)
+    assert out.shape == x.shape
+    assert out.dtype == jnp.float32
+    # Zero-init output head: the untrained model predicts exactly zero.
+    np.testing.assert_array_equal(np.asarray(out), 0.0)
+
+
+def test_unet_rejects_non_nhwc(world):
+    model = _tiny_unet()
+    with pytest.raises(ValueError, match="NHWC"):
+        model.init(jax.random.PRNGKey(0), jnp.ones((4, 16, 16)),
+                   jnp.zeros((4,), jnp.int32))
+
+
+def test_timestep_embedding_distinguishes_large_t(world):
+    from fluxmpi_tpu.models.unet import timestep_embedding
+
+    t = jnp.array([998, 999], jnp.int32)
+    emb = timestep_embedding(t, 64)
+    assert emb.dtype == jnp.float32
+    assert not np.allclose(np.asarray(emb[0]), np.asarray(emb[1]))
+
+
+def test_cosine_schedule_invariants(world):
+    from fluxmpi_tpu.models import cosine_beta_schedule
+    from fluxmpi_tpu.models.unet import _alpha_bars
+
+    betas = cosine_beta_schedule(100)
+    assert betas.shape == (100,)
+    # 0.999 in f32 is 0.99900001...: compare with an epsilon.
+    assert float(betas.min()) >= 0.0
+    assert float(betas.max()) <= 0.999 + 1e-6
+    ab = _alpha_bars(betas)
+    # alpha_bar decreases monotonically from ~1 toward 0.
+    assert float(ab[0]) > 0.99
+    assert float(ab[-1]) < 0.01
+    assert np.all(np.diff(np.asarray(ab)) <= 0)
+
+
+def test_ddpm_loss_at_zero_head_is_unit_mse(world):
+    """Zero-init head predicts eps=0, so the loss starts at E[eps^2] = 1."""
+    from fluxmpi_tpu.models import cosine_beta_schedule, ddpm_loss
+
+    model = _tiny_unet()
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 16, 16, 3))
+    betas = cosine_beta_schedule(50)
+    params = model.init(jax.random.PRNGKey(0), x,
+                        jnp.zeros((4,), jnp.int32))
+    loss = ddpm_loss(model, params, x, jax.random.PRNGKey(2), betas)
+    assert np.isfinite(float(loss))
+    assert abs(float(loss) - 1.0) < 0.15
+
+
+def test_unet_dp_train_step_descends(world):
+    """The family trains under make_train_step on the 8-device mesh, with
+    the per-step rng folded in data-parallel-deterministically."""
+    import fluxmpi_tpu as fm
+    from fluxmpi_tpu.models import cosine_beta_schedule, ddpm_loss
+    from fluxmpi_tpu.parallel import TrainState, make_train_step
+    from fluxmpi_tpu.parallel.train import replicate, shard_batch
+
+    mesh = fm.init()
+    model = _tiny_unet()
+    betas = cosine_beta_schedule(50)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 16, 16, 3))
+    params = model.init(jax.random.PRNGKey(0), x[:2],
+                        jnp.zeros((2,), jnp.int32))
+
+    def loss_fn(p, ms, batch):
+        imgs, step_idx = batch
+        rng = jax.random.fold_in(jax.random.PRNGKey(7), step_idx[0])
+        return ddpm_loss(model, p, imgs, rng, betas), ms
+
+    tx = optax.adam(2e-3)
+    step = make_train_step(loss_fn, tx, mesh=mesh, style="auto")
+    state = replicate(TrainState.create(params, tx, None), mesh)
+
+    losses = []
+    for i in range(8):
+        batch = shard_batch(
+            (x, jnp.full((8,), i, jnp.int32)), mesh)
+        state, loss = step(state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()
+
+
+def test_ddim_sample_shapes_and_finiteness(world):
+    from fluxmpi_tpu.models import cosine_beta_schedule, ddim_sample
+
+    model = _tiny_unet()
+    betas = cosine_beta_schedule(20)
+    x = jnp.ones((2, 16, 16, 3))
+    params = model.init(jax.random.PRNGKey(0), x,
+                        jnp.zeros((2,), jnp.int32))
+    out = jax.jit(
+        lambda p, r: ddim_sample(model, p, r, shape=(2, 16, 16, 3),
+                                 betas=betas, num_steps=5, clip_x0=None)
+    )(params, jax.random.PRNGKey(3))
+    assert out.shape == (2, 16, 16, 3)
+    assert np.isfinite(np.asarray(out)).all()
+    # Zero-eps model + eta=0 + no clip: x_{t-1} = sqrt(ab_prev/ab_t) x_t,
+    # telescoping to x / sqrt(ab_T) — the sampler output is a deterministic
+    # rescale of its own initial noise. Verifies the trajectory arithmetic
+    # end to end.
+    from fluxmpi_tpu.models.unet import _alpha_bars
+
+    x_rng = jax.random.split(jax.random.PRNGKey(3))[1]
+    x0 = jax.random.normal(x_rng, (2, 16, 16, 3), jnp.float32)
+    ab = _alpha_bars(betas)
+    expected = x0 / jnp.sqrt(ab[-1])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ddim_sample_clip_bounds_output(world):
+    """With the default clip, the final sample is within the data range
+    (the last step returns ~x0, which is clamped)."""
+    from fluxmpi_tpu.models import cosine_beta_schedule, ddim_sample
+
+    model = _tiny_unet()
+    betas = cosine_beta_schedule(20)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 16, 16, 3)),
+                        jnp.zeros((1,), jnp.int32))
+    out = ddim_sample(model, params, jax.random.PRNGKey(5),
+                      shape=(2, 16, 16, 3), betas=betas, num_steps=10)
+    assert np.isfinite(np.asarray(out)).all()
+    assert float(jnp.abs(out).max()) <= 1.0 + 1e-5
+
+
+def test_ddim_sample_validates_num_steps(world):
+    from fluxmpi_tpu.models import cosine_beta_schedule, ddim_sample
+
+    model = _tiny_unet()
+    betas = cosine_beta_schedule(10)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 16, 16, 3)),
+                        jnp.zeros((1,), jnp.int32))
+    with pytest.raises(ValueError, match="num_steps"):
+        ddim_sample(model, params, jax.random.PRNGKey(0),
+                    shape=(1, 16, 16, 3), betas=betas, num_steps=11)
+
+
+def test_unet_attention_fn_hook(world):
+    """A custom attention_fn must be called and change nothing when it is
+    the dense reference implementation."""
+    import flax.linen as nn
+
+    calls = []
+
+    def spy_attention(q, k, v, **kw):
+        calls.append(q.shape)
+        return nn.dot_product_attention(q, k, v, **kw)
+
+    model_a = _tiny_unet()
+    model_b = _tiny_unet(attention_fn=spy_attention)
+    x = jnp.ones((2, 16, 16, 3))
+    t = jnp.zeros((2,), jnp.int32)
+    params = model_a.init(jax.random.PRNGKey(0), x, t)
+    out_a = model_a.apply(params, x, t)
+    out_b = model_b.apply(params, x, t)
+    assert calls, "attention_fn hook was never invoked"
+    # 8x8 attn resolution -> 64 tokens.
+    assert all(s[1] == 64 for s in calls)
+    np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b),
+                               rtol=1e-5, atol=1e-5)
