@@ -34,7 +34,8 @@ machine is never presented as a regression ratio.
 
 Env knobs:
   FLUXMPI_TPU_BENCH_CONFIG    force one config
-                              (resnet50|cnn|mlp|attention|transformer|deq)
+                              (resnet50|cnn|mlp|attention|transformer|deq|
+                              unet — forced-only, not in the fallback plan)
   FLUXMPI_TPU_BENCH_TIMEOUT   override per-config child timeout in seconds
   FLUXMPI_TPU_BENCH_BUDGET    overall wall budget in seconds (default 4200;
                               sized so the 1800 s lease-TTL probe attempt
@@ -254,6 +255,7 @@ def _bench_workload(
     analytic_flops_per_sample: float | None = None,
     loader_fed: bool = False,
     value_scale: float = 1.0,
+    init_fn=None,
 ):
     """Shared harness: synthetic batch → compiled DP train step → per-chip
     throughput. ``make_model_batch(n_dev)`` returns
@@ -271,7 +273,12 @@ def _bench_workload(
     device_kind = devs[0].device_kind
     model, x, y, loss_fn, optimizer = make_model_batch(n_dev)
 
-    if stateful:
+    if init_fn is not None:
+        # Models whose __call__ is not (x, train=) shaped (e.g. the UNet's
+        # (x, t)) bring their own initializer.
+        params = init_fn()
+        model_state = None
+    elif stateful:
         variables = model.init(jax.random.PRNGKey(0), x[:2], train=False)
         params = variables["params"]
         model_state = variables.get("batch_stats")
@@ -644,6 +651,73 @@ def _bench_transformer():
     )
 
 
+def _bench_unet():
+    """DDPM UNet train step (epsilon-prediction MSE): the generative-vision
+    workload — GroupNorm conv stages + spatial attention, conv-dominated
+    like ResNet but without BatchNorm cross-batch state. images/sec/chip.
+    Optional config: not in the headline fallback plan; run it via
+    FLUXMPI_TPU_BENCH_CONFIG=unet."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from fluxmpi_tpu.models import UNet, cosine_beta_schedule, ddpm_loss
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        side, base, mults, per_chip = 32, 128, (1, 2, 2, 4), 64
+        attn_res = side // 4
+    else:  # CPU smoke configuration
+        side, base, mults, per_chip = 8, 8, (1, 2), 4
+        # side//4 == 2 is never a reached resolution (sides are 8 and 4):
+        # pin 4 so the stage-level attention blocks trace on CPU too, not
+        # just the unconditional mid_attn.
+        attn_res = 4
+
+    holder = {}
+
+    def make(n_dev):
+        model = UNet(
+            out_channels=3, base_channels=base, channel_mults=mults,
+            blocks_per_stage=2, attn_resolutions=(attn_res,),
+            groups=8 if base >= 32 else 4,
+            dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+        )
+        batch = per_chip * n_dev
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(batch, side, side, 3)),
+                        jnp.float32)
+        y = jnp.zeros((batch,), jnp.int32)  # unused; harness shape slot
+        betas = cosine_beta_schedule(1000)
+
+        def loss_fn(p, mstate, b):
+            bx, _ = b
+            # Fixed rng: identical timestep/noise draws every step — the
+            # compute being timed is constant across steps by design.
+            return (
+                ddpm_loss(model, p, bx, jax.random.PRNGKey(0), betas),
+                mstate,
+            )
+
+        holder.update(model=model, x=x)
+        return model, x, y, loss_fn, optax.adam(1e-4)
+
+    return _bench_workload(
+        make_model_batch=make,
+        stateful=False,
+        metric_name="unet_ddpm_images_per_sec_per_chip",
+        unit="images/sec/chip",
+        steps=20,
+        ndigits=1,
+        # No clean analytic formula for the UNet topology: use XLA's
+        # compiled cost analysis (flops_source recorded in the output).
+        init_fn=lambda: holder["model"].init(
+            jax.random.PRNGKey(0), holder["x"][:2],
+            jnp.zeros((2,), jnp.int32),
+        ),
+    )
+
+
 def _bench_attention():
     """Flash (Pallas) vs XLA dense attention, fwd+bwd, bf16 — the "fast,
     not just correct" check on the one first-party kernel. Headline value is
@@ -772,6 +846,7 @@ _CHILD_FNS = {
     "attention": _bench_attention,
     "transformer": _bench_transformer,
     "deq": _bench_deq,
+    "unet": _bench_unet,
 }
 
 
@@ -1000,9 +1075,11 @@ def main() -> None:
 
     if forced:
         # A forced config never consults the probe — run it directly.
-        child_to = float(timeout_override) if timeout_override else dict(
-            _CONFIGS
-        ).get(forced, 300.0)
+        # unet is forced-only (not in the fallback plan) but is as
+        # compile-heavy as resnet50 on a cold cache: same 900 s.
+        child_to = float(timeout_override) if timeout_override else {
+            **dict(_CONFIGS), "unet": 900.0,
+        }.get(forced, 300.0)
         result = _run_child(forced, child_to, platform)
         if result is not None:
             print(json.dumps(result))
