@@ -52,6 +52,7 @@ def generate(
     *,
     temperature: float = 0.0,
     top_k: int | None = None,
+    top_p: float | None = None,
     eos_token: int | None = None,
     rng: jax.Array | None = None,
 ) -> jnp.ndarray:
@@ -68,6 +69,10 @@ def generate(
         temperature (requires ``rng``).
       top_k: with sampling, restrict to the k highest-probability tokens
         before drawing.
+      top_p: with sampling, nucleus filtering — keep the smallest set of
+        highest-probability tokens whose cumulative probability reaches
+        ``top_p`` (the most-probable token always survives). Composes
+        with ``top_k`` (k-filter first, then the nucleus).
       eos_token: once a row emits this token, every later position in
         that row is forced to it (shapes stay static; the scan still
         runs ``max_new_tokens`` ticks).
@@ -91,6 +96,8 @@ def generate(
         raise ValueError("temperature > 0 requires an rng key")
     if top_k is not None and top_k < 1:
         raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if rng is None:
         rng = jax.random.PRNGKey(0)
 
@@ -122,7 +129,21 @@ def generate(
             if top_k is not None and top_k < logits.shape[-1]:
                 kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
                 logits = jnp.where(logits < kth, -jnp.inf, logits)
-            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+            scaled = logits / temperature
+            if top_p is not None and top_p < 1.0:
+                # Nucleus: the kept set is a prefix of the descending
+                # sort whose EXCLUSIVE cumulative probability is < p (so
+                # the argmax token always survives); everything below
+                # the prefix's smallest logit is masked.
+                srt = jnp.sort(scaled, axis=-1)[:, ::-1]
+                probs = jax.nn.softmax(srt, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                keep = (cum - probs) < top_p
+                thresh = jnp.min(
+                    jnp.where(keep, srt, jnp.inf), axis=-1, keepdims=True
+                )
+                scaled = jnp.where(scaled < thresh, -jnp.inf, scaled)
+            nxt = jax.random.categorical(sub, scaled, axis=-1)
         else:
             nxt = jnp.argmax(logits, axis=-1)
         # Prefill: while the NEXT position is still inside the prompt,

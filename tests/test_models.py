@@ -652,3 +652,43 @@ def test_generate_eos_and_top_k(world):
     with _pytest.raises(ValueError, match="top_k"):
         generate(lm, variables, prompt, 4, temperature=1.0, top_k=0,
                  rng=jax.random.PRNGKey(0))
+
+
+def test_generate_top_p(world):
+    from fluxmpi_tpu.models import TransformerLM, generate
+
+    lm = TransformerLM(vocab_size=16, max_len=20, num_layers=1, d_model=16,
+                       num_heads=2, d_ff=32)
+    prompt = jnp.zeros((2, 3), jnp.int32)
+    variables = lm.init(jax.random.PRNGKey(0), prompt, train=False)
+
+    greedy = np.asarray(generate(lm, variables, prompt, 8))
+    # A tiny nucleus keeps only the argmax token: sampling == greedy at
+    # any temperature.
+    tiny = np.asarray(generate(lm, variables, prompt, 8, temperature=3.0,
+                               top_p=1e-6, rng=jax.random.PRNGKey(4)))
+    np.testing.assert_array_equal(tiny, greedy)
+
+    # top_p=1.0 is a no-op: bit-identical to unfiltered sampling with the
+    # same key.
+    full = np.asarray(generate(lm, variables, prompt, 8, temperature=1.0,
+                               top_p=1.0, rng=jax.random.PRNGKey(5)))
+    plain = np.asarray(generate(lm, variables, prompt, 8, temperature=1.0,
+                                rng=jax.random.PRNGKey(5)))
+    np.testing.assert_array_equal(full, plain)
+
+    # Composes with top_k and stays in-vocab / finite.
+    both = np.asarray(generate(lm, variables, prompt, 8, temperature=1.0,
+                               top_k=8, top_p=0.9,
+                               rng=jax.random.PRNGKey(6)))
+    assert both.shape == (2, 11)
+    assert (both >= 0).all() and (both < 16).all()
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="top_p"):
+        generate(lm, variables, prompt, 4, temperature=1.0, top_p=0.0,
+                 rng=jax.random.PRNGKey(0))
+    with _pytest.raises(ValueError, match="top_p"):
+        generate(lm, variables, prompt, 4, temperature=1.0, top_p=1.5,
+                 rng=jax.random.PRNGKey(0))
