@@ -84,7 +84,11 @@ step = make_train_step(loss_fn, optimizer, mesh=mesh)
 state = replicate(TrainState.create(params, optimizer, None), mesh)
 
 from fluxmpi_tpu.parallel.train import shard_batch  # noqa: E402
+from fluxmpi_tpu.utils import ema_init, ema_params, ema_update  # noqa: E402
 
+# Short toy run; production diffusion uses 0.999+. The eager per-step
+# update is fine at toy scale (see utils/ema.py for the fused option).
+ema = ema_init(params, decay=0.95)
 first = last = None
 i = 0
 while i < args.steps:
@@ -96,6 +100,7 @@ while i < args.steps:
             jnp.full((batch["x"].shape[0],), i, jnp.int32), mesh
         )
         state, loss = step(state, batch)
+        ema = ema_update(ema, state.params)
         if first is None:
             first = float(loss)
         last = float(loss)
@@ -106,7 +111,7 @@ assert last < first * 0.7, (first, last)
 samples = jax.jit(
     lambda p, r: ddim_sample(model, p, r, shape=(4, 8, 8, 1), betas=betas,
                              num_steps=20)
-)(state.params, jax.random.PRNGKey(1))
+)(ema_params(ema), jax.random.PRNGKey(1))
 samples = np.asarray(samples)
 assert np.isfinite(samples).all()
 # The sampler clips its x0 estimate to the data range, so even this

@@ -6,3 +6,4 @@ from .checkpoint import (  # noqa: F401
     save_checkpoint,
 )
 from .profiling import profile_trace, step_timer  # noqa: F401
+from .ema import EMAState, ema_init, ema_params, ema_update  # noqa: F401
