@@ -72,6 +72,9 @@ _CONFIGS: tuple[tuple[str, float], ...] = (
 # interpreter start + jax import, and killing a probe child at the moment
 # it finally acquires the lease would re-arm the TTL for the next client.
 _DEFAULT_PROBE_TIMEOUTS = (120.0, 300.0, 1800.0)
+# Default overall budget: the full probe ladder + the 900 s headline child
+# must fit (tests/test_bench.py pins the invariant).
+_DEFAULT_BUDGET_S = 4200.0
 # Platform variant tried at each probe attempt: None = leave the env alone,
 # "" = JAX_PLATFORMS='' (let jax auto-pick — round 1's own error message
 # suggested exactly this), "tpu" = demand the TPU backend.
@@ -1059,7 +1062,9 @@ def _run_scaling(
 
 def main() -> None:
     t_start = time.monotonic()
-    budget = float(os.environ.get("FLUXMPI_TPU_BENCH_BUDGET", "4200"))
+    budget = float(
+        os.environ.get("FLUXMPI_TPU_BENCH_BUDGET", str(_DEFAULT_BUDGET_S))
+    )
 
     def remaining() -> float:
         return budget - (time.monotonic() - t_start)

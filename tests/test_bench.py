@@ -148,3 +148,25 @@ def test_run_scaling_single_chip_falls_back(monkeypatch):
         3000.0, {"platform": "tpu", "n_devices": 1}, None
     )
     assert out["mode"] == "cpu-virtual"
+
+
+def test_peak_table_orders_v5p_before_v5_lite():
+    # Substring lookup: "TPU v5p" must hit the v5p row, not "v5 lite"/v5e.
+    assert bench._chip_peak_flops("TPU v5p") == 459e12
+    assert bench._chip_peak_flops("TPU v5 lite") == 197e12
+
+
+def test_parse_json_line_rejects_non_dict():
+    assert bench._parse_json_line("[1, 2]\n") is None
+
+
+def test_probe_ladder_outlasts_lease_ttl():
+    """Round-5 invariant (BENCH_NOTES_r05.md): after an unclean client
+    kill the next backend init blocks ~1500 s; one probe attempt must
+    outlast that or a merely-queued chip is reported dead — and the
+    default budget must still leave the headline child its slot after
+    the full ladder runs."""
+    assert max(bench._DEFAULT_PROBE_TIMEOUTS) >= 1560
+    ladder = sum(bench._DEFAULT_PROBE_TIMEOUTS)
+    headline = dict(bench._CONFIGS)["resnet50"]
+    assert bench._DEFAULT_BUDGET_S >= ladder + headline + 60
