@@ -19,9 +19,10 @@ framework's to choose). Built TPU-first:
   steps.
 
 ``ddpm_loss`` / ``cosine_beta_schedule`` / ``ddim_sample`` implement the
-standard epsilon-prediction objective so the family is trainable end to
-end with :func:`fluxmpi_tpu.parallel.make_train_step` like every other
-zoo model.
+standard epsilon-prediction objective (``pred_type="v"`` switches both
+to the velocity parameterization) so the family is trainable end to end
+with :func:`fluxmpi_tpu.parallel.make_train_step` like every other zoo
+model.
 """
 
 from __future__ import annotations
@@ -123,7 +124,9 @@ class AttnBlock(nn.Module):
 
 
 class UNet(nn.Module):
-    """DDPM UNet over NHWC images; predicts per-pixel noise epsilon.
+    """DDPM UNet over NHWC images; predicts per-pixel noise epsilon
+    (or velocity — the objective is chosen by the loss/sampler
+    ``pred_type``, not the architecture).
 
     Defaults are a compact 32x32 config. ``channel_mults`` sets the
     depth: resolution halves (strided conv) between stages, channels
@@ -223,26 +226,42 @@ def _alpha_bars(betas: jnp.ndarray) -> jnp.ndarray:
 
 
 def ddpm_loss(model: nn.Module, params, batch: jnp.ndarray,
-              rng: jax.Array, betas: jnp.ndarray) -> jnp.ndarray:
-    """Epsilon-prediction MSE at uniformly sampled timesteps.
+              rng: jax.Array, betas: jnp.ndarray, *,
+              pred_type: str = "eps") -> jnp.ndarray:
+    """Diffusion MSE at uniformly sampled timesteps.
 
     ``batch`` is NHWC in [-1, 1]. All schedule math is f32; the model
     dtype only affects the network interior.
+
+    ``pred_type``: ``"eps"`` — the network predicts the added noise (the
+    DDPM objective); ``"v"`` — it predicts the velocity
+    ``v = sqrt(ab)·eps − sqrt(1−ab)·x0`` (progressive-distillation
+    parameterization: better-conditioned at both ends of the schedule
+    and the standard choice for distilled/few-step samplers). Train and
+    sample with the SAME ``pred_type``.
     """
+    if pred_type not in ("eps", "v"):
+        raise ValueError(f"pred_type must be 'eps' or 'v', got {pred_type!r}")
     b = batch.shape[0]
     t_rng, eps_rng = jax.random.split(rng)
     tsteps = jax.random.randint(t_rng, (b,), 0, betas.shape[0])
     eps = jax.random.normal(eps_rng, batch.shape, jnp.float32)
+    x0 = batch.astype(jnp.float32)
     ab = _alpha_bars(betas)[tsteps][:, None, None, None]
-    x_t = jnp.sqrt(ab) * batch.astype(jnp.float32) + jnp.sqrt(1.0 - ab) * eps
+    x_t = jnp.sqrt(ab) * x0 + jnp.sqrt(1.0 - ab) * eps
+    target = (
+        eps if pred_type == "eps"
+        else jnp.sqrt(ab) * eps - jnp.sqrt(1.0 - ab) * x0
+    )
     pred = model.apply(params, x_t, tsteps)
-    return jnp.mean((pred.astype(jnp.float32) - eps) ** 2)
+    return jnp.mean((pred.astype(jnp.float32) - target) ** 2)
 
 
 def ddim_sample(model: nn.Module, params, rng: jax.Array, *,
                 shape: tuple[int, ...], betas: jnp.ndarray,
                 num_steps: int = 50, eta: float = 0.0,
-                clip_x0: float | None = 1.0) -> jnp.ndarray:
+                clip_x0: float | None = 1.0,
+                pred_type: str = "eps") -> jnp.ndarray:
     """Deterministic (eta=0) / stochastic DDIM sampler.
 
     One compiled ``lax.fori_loop`` over ``num_steps`` subsampled
@@ -253,7 +272,14 @@ def ddim_sample(model: nn.Module, params, rng: jax.Array, *,
     (pass ``None`` to disable). At the noisiest timesteps
     ``1/sqrt(alpha_bar)`` is O(1e3), so un-clamped eps error explodes the
     trajectory; clamping to the data range is the standard stabilizer.
+
+    ``pred_type`` must match the objective the model was trained with
+    (see :func:`ddpm_loss`): with ``"v"`` the network output is converted
+    to eps via ``eps = sqrt(ab)·v + sqrt(1−ab)·x_t`` before the usual
+    DDIM update.
     """
+    if pred_type not in ("eps", "v"):
+        raise ValueError(f"pred_type must be 'eps' or 'v', got {pred_type!r}")
     T = betas.shape[0]
     if not 1 <= num_steps <= T:
         raise ValueError(f"num_steps must be in [1, {T}], got {num_steps}")
@@ -270,7 +296,11 @@ def ddim_sample(model: nn.Module, params, rng: jax.Array, *,
         x, rng = carry
         a_t, a_p = ab_t[i], ab_prev[i]
         t_vec = jnp.full((shape[0],), ts[i], jnp.int32)
-        eps = model.apply(params, x, t_vec).astype(jnp.float32)
+        out = model.apply(params, x, t_vec).astype(jnp.float32)
+        if pred_type == "v":
+            eps = jnp.sqrt(a_t) * out + jnp.sqrt(1.0 - a_t) * x
+        else:
+            eps = out
         x0 = (x - jnp.sqrt(1.0 - a_t) * eps) / jnp.sqrt(a_t)
         if clip_x0 is not None:
             x0 = jnp.clip(x0, -clip_x0, clip_x0)
