@@ -178,6 +178,34 @@ def test_unet_dp_train_step_descends(world):
     assert np.isfinite(losses).all()
 
 
+def test_unet_train_step_with_remat_dots(world):
+    """The conv family composes with the checkpoint_dots remat policy
+    under make_train_step (the TPU HBM-pressure configuration)."""
+    import fluxmpi_tpu as fm
+    from fluxmpi_tpu.models import cosine_beta_schedule, ddpm_loss
+    from fluxmpi_tpu.parallel import TrainState, make_train_step
+    from fluxmpi_tpu.parallel.train import replicate, shard_batch
+
+    mesh = fm.init()
+    model = _tiny_unet()
+    betas = cosine_beta_schedule(20)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 16, 16, 3))
+    params = model.init(jax.random.PRNGKey(0), x[:2],
+                        jnp.zeros((2,), jnp.int32))
+
+    def loss_fn(p, ms, batch):
+        imgs, idx = batch
+        rng = jax.random.fold_in(jax.random.PRNGKey(7), idx[0])
+        return ddpm_loss(model, p, imgs, rng, betas), ms
+
+    tx = optax.adam(1e-3)
+    step = make_train_step(loss_fn, tx, mesh=mesh, remat="dots")
+    state = replicate(TrainState.create(params, tx, None), mesh)
+    batch = shard_batch((x, jnp.zeros((8,), jnp.int32)), mesh)
+    state, loss = step(state, batch)
+    assert np.isfinite(float(loss))
+
+
 def test_ddim_sample_shapes_and_finiteness(world):
     from fluxmpi_tpu.models import cosine_beta_schedule, ddim_sample
 

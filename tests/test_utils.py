@@ -399,3 +399,22 @@ def test_ema_guard_and_jit(world):
 
     np.testing.assert_allclose(np.asarray(roll(params)["w"]), 1.0,
                                rtol=1e-5)
+
+
+def test_ema_state_checkpoints(world, tmp_path):
+    """EMAState rides checkpoints like any pytree: save mid-training,
+    restore, and the debiased params match."""
+    from fluxmpi_tpu.utils import (ema_init, ema_params, ema_update,
+                                   restore_checkpoint, save_checkpoint)
+
+    params = {"w": jnp.arange(6.0).reshape(2, 3)}
+    ema = ema_init(params, decay=0.9)
+    for i in range(3):
+        ema = ema_update(ema, {"w": params["w"] + i})
+    path = str(tmp_path / "ema_ckpt")
+    save_checkpoint(path, ema)
+    blank = ema_init(params, decay=0.9)
+    restored = restore_checkpoint(path, blank)
+    assert int(restored.count) == 3
+    np.testing.assert_allclose(np.asarray(ema_params(restored)["w"]),
+                               np.asarray(ema_params(ema)["w"]), rtol=1e-6)
