@@ -34,7 +34,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["generate"]
+__all__ = ["generate", "beam_search"]
 
 
 def _decode_twin(model):
@@ -42,6 +42,47 @@ def _decode_twin(model):
     identical parameter tree (``decode``/``attention_fn``/``dropout``
     affect computation, not parameters)."""
     return model.clone(decode=True, attention_fn=None, dropout=0.0)
+
+
+def _validate_lengths(model, plen: int, max_new_tokens: int) -> int:
+    """Shared prompt/continuation length checks; returns total length."""
+    total = plen + int(max_new_tokens)
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if total > model.max_len:
+        raise ValueError(
+            f"prompt_len + max_new_tokens = {total} exceeds the model's "
+            f"max_len {model.max_len}"
+        )
+    return total
+
+
+def _validate_eos(model, eos_token: int | None) -> None:
+    """An out-of-range eos can never be emitted (and its scatter into
+    the absorption row is silently dropped) — surface the argument
+    mistake instead of letting it look like a model problem."""
+    if eos_token is not None and not 0 <= eos_token < model.vocab_size:
+        raise ValueError(
+            f"eos_token {eos_token} is outside the model's vocabulary "
+            f"[0, {model.vocab_size})"
+        )
+
+
+def _sized_cache(twin, rows: int, total: int):
+    """Zero KV caches sized for ``rows`` sequences of length ``total``.
+
+    flax's decode caches initialize to zeros (keys, values, index), so
+    building them from ``eval_shape`` alone is exact and skips the full
+    wasted forward pass a real init would run."""
+    shapes = jax.eval_shape(
+        lambda: twin.init(
+            jax.random.PRNGKey(0), jnp.zeros((rows, total), jnp.int32),
+            train=False,
+        )["cache"]
+    )
+    return jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes
+    )
 
 
 def generate(
@@ -82,14 +123,7 @@ def generate(
       followed by the generated continuation.
     """
     b, plen = prompt.shape
-    total = plen + int(max_new_tokens)
-    if max_new_tokens < 1:
-        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
-    if total > model.max_len:
-        raise ValueError(
-            f"prompt_len + max_new_tokens = {total} exceeds the model's "
-            f"max_len {model.max_len}"
-        )
+    total = _validate_lengths(model, plen, max_new_tokens)
     if temperature < 0:
         raise ValueError(f"temperature must be >= 0, got {temperature}")
     if temperature > 0 and rng is None:
@@ -98,23 +132,12 @@ def generate(
         raise ValueError(f"top_k must be >= 1, got {top_k}")
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    _validate_eos(model, eos_token)
     if rng is None:
         rng = jax.random.PRNGKey(0)
 
     twin = _decode_twin(model)
-    # Size the KV caches from the full sequence length via eval_shape —
-    # flax's decode caches initialize to zeros (keys, values, index), so
-    # building them from the shapes alone is exact and skips the full
-    # wasted forward pass a real init would run.
-    shapes = jax.eval_shape(
-        lambda: twin.init(
-            jax.random.PRNGKey(0), jnp.zeros((b, total), jnp.int32),
-            train=False,
-        )["cache"]
-    )
-    cache = jax.tree_util.tree_map(
-        lambda s: jnp.zeros(s.shape, s.dtype), shapes
-    )
+    cache = _sized_cache(twin, b, total)
     prompt = prompt.astype(jnp.int32)
 
     def body(carry, _):
@@ -163,3 +186,178 @@ def generate(
     _, toks = jax.lax.scan(body, init, None, length=total - 1)
     # toks: [total-1, b] — tokens for positions 1..total-1.
     return jnp.concatenate([prompt[:, :1], toks.T], axis=1)
+
+
+def beam_search(
+    model,
+    params,
+    prompt: jnp.ndarray,
+    max_new_tokens: int,
+    *,
+    beam_size: int,
+    length_penalty: float = 0.0,
+    eos_token: int | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Beam-search decoding: the highest-scoring continuation under the
+    model's log-likelihood, explored ``beam_size`` hypotheses at a time.
+
+    Completes the inference surface next to :func:`generate`'s sampling
+    modes (the reference is training-only — its models are user-land
+    Flux code — so this, like ``generate``, is "complete framework"
+    surface beyond parity). Built the TPU way:
+
+    - The prompt prefills the KV cache on ``batch`` rows (one
+      teacher-forced scan), and only then does the cache repeat into
+      ``rows = batch * beam_size`` — the beam loop never re-runs prompt
+      work ``beam_size`` times over.
+    - Beams fold into the batch dimension, so every decode tick is ONE
+      batched forward on the KV cache — no per-beam loops.
+    - Beam reordering is a static-shape gather: the token matrix, the
+      cumulative scores, and every cache array with a leading ``rows``
+      dim are re-indexed by the selected parents each tick (flax's
+      scalar ``cache_index`` passes through untouched).
+    - The search is two ``lax.scan`` s (prefill + beam loop) — static
+      shapes, single compiled program, no host round trips.
+
+    Finished hypotheses are absorbed rather than swapped out: once a
+    beam emits ``eos_token`` its only legal continuation is ``eos`` at
+    zero added log-probability, so its score freezes while shapes stay
+    static. Candidates are RANKED by the GNMT-penalized score
+    ``cum_logp / ((5 + L) / 6) ** length_penalty`` both during pruning
+    (``L`` = frozen finish length for finished beams, tokens-so-far for
+    live ones — all live candidates at a tick share the same ``L``, so
+    within-live order matches raw log-probability) and at final
+    selection; the returned score uses the same formula.
+    ``length_penalty=0`` reduces everything to raw summed
+    log-probability.
+
+    Args:
+      model: a :class:`fluxmpi_tpu.models.TransformerLM` (training
+        configuration — the decode twin is derived internally).
+      params: its variables (``{"params": ...}``).
+      prompt: int32 ``[batch, prompt_len]`` (``prompt_len >= 1``).
+      max_new_tokens: continuation length; ``prompt_len +
+        max_new_tokens`` must fit ``model.max_len``.
+      beam_size: hypotheses kept per batch row (>= 1; ``beam_size=1``
+        reduces to greedy :func:`generate`).
+      length_penalty: GNMT alpha; > 0 favors longer finished hypotheses.
+      eos_token: absorbing end-of-sequence token (see above). Without
+        it every hypothesis runs the full ``max_new_tokens``.
+
+    Returns:
+      ``(tokens, scores)`` — int32 ``[batch, prompt_len +
+      max_new_tokens]`` best sequence per batch row (positions after a
+      hypothesis' ``eos`` are ``eos``), and float32 ``[batch]`` its
+      length-penalized log-probability score.
+    """
+    b, plen = prompt.shape
+    total = _validate_lengths(model, plen, max_new_tokens)
+    if beam_size < 1:
+        raise ValueError(f"beam_size must be >= 1, got {beam_size}")
+    _validate_eos(model, eos_token)
+
+    beam = int(beam_size)
+    rows = b * beam
+    vocab = model.vocab_size
+    alpha = float(length_penalty)
+    twin = _decode_twin(model)
+    prompt = prompt.astype(jnp.int32)
+
+    def _lp(length):
+        return ((5.0 + length.astype(jnp.float32)) / 6.0) ** alpha
+
+    # --- Prefill: teacher-force the prompt on b rows, then repeat the
+    # warmed cache into b*beam rows (beam-contiguous per batch row, to
+    # match the flat index used by the reorder gather below). ----------
+    cache = _sized_cache(twin, b, total)
+
+    def pf_body(carry, tok):
+        cache, pos = carry
+        _, mutated = twin.apply(
+            {"params": params["params"], "cache": cache},
+            tok[:, None], train=False, pos_offset=pos, mutable=["cache"],
+        )
+        return (mutated["cache"], pos + 1), None
+
+    (cache, _), _ = jax.lax.scan(
+        pf_body, (cache, jnp.asarray(0)), prompt[:, : plen - 1].T
+    )
+    cache = jax.tree_util.tree_map(
+        lambda x: jnp.repeat(x, beam, axis=0)
+        if x.ndim >= 1 and x.shape[0] == b else x,
+        cache,
+    )
+
+    toks0 = jnp.zeros((b, beam, total), jnp.int32)
+    toks0 = toks0.at[:, :, :plen].set(prompt[:, None, :])
+    # Only beam 0 is live at the start — identical hypotheses must not
+    # fill the whole beam with duplicates on the first expansion.
+    cum0 = jnp.full((b, beam), -jnp.inf, jnp.float32).at[:, 0].set(0.0)
+    done0 = jnp.zeros((b, beam), bool)
+    flen0 = jnp.full((b, beam), max_new_tokens, jnp.int32)
+
+    def _reorder_cache(cache, parent):
+        flat = (parent + jnp.arange(b)[:, None] * beam).reshape(rows)
+        return jax.tree_util.tree_map(
+            lambda x: x[flat] if x.ndim >= 1 and x.shape[0] == rows else x,
+            cache,
+        )
+
+    def body(carry, _):
+        cache, toks, cum, done, flen, pos = carry
+        tok = jax.lax.dynamic_slice_in_dim(
+            toks.reshape(rows, total), pos, 1, axis=1
+        )
+        logits, mutated = twin.apply(
+            {"params": params["params"], "cache": cache},
+            tok, train=False, pos_offset=pos, mutable=["cache"],
+        )
+        cache = mutated["cache"]
+        logp = jax.nn.log_softmax(
+            logits[:, -1].astype(jnp.float32), axis=-1
+        ).reshape(b, beam, vocab)
+        if eos_token is not None:
+            # Absorbing state: a finished beam continues only as eos, at
+            # zero added log-probability (its score freezes).
+            eos_row = jnp.full((vocab,), -jnp.inf, jnp.float32)
+            eos_row = eos_row.at[int(eos_token)].set(0.0)
+            logp = jnp.where(done[:, :, None], eos_row[None, None], logp)
+        raw = (cum[:, :, None] + logp).reshape(b, beam * vocab)
+        gen_count = pos + 2 - plen  # generated tokens incl. this tick's
+        if alpha != 0.0:
+            # Prune on the penalized score the function optimizes:
+            # finished parents keep their frozen length, live candidates
+            # use tokens-so-far (identical across vocab, so the penalty
+            # is per-beam).
+            pen = _lp(jnp.where(done, flen, gen_count))  # [b, beam]
+            rank = (
+                raw.reshape(b, beam, vocab) / pen[:, :, None]
+            ).reshape(b, beam * vocab)
+        else:
+            rank = raw
+        _, top_idx = jax.lax.top_k(rank, beam)
+        cum = jnp.take_along_axis(raw, top_idx, axis=1)
+        parent = top_idx // vocab
+        token = (top_idx % vocab).astype(jnp.int32)
+
+        toks = jnp.take_along_axis(toks, parent[:, :, None], axis=1)
+        toks = jax.lax.dynamic_update_slice_in_dim(
+            toks, token[:, :, None], pos + 1, axis=2
+        )
+        done = jnp.take_along_axis(done, parent, axis=1)
+        flen = jnp.take_along_axis(flen, parent, axis=1)
+        if eos_token is not None:
+            ends_now = (token == eos_token) & jnp.logical_not(done)
+            flen = jnp.where(ends_now, gen_count, flen)
+            done = done | (token == eos_token)
+        cache = _reorder_cache(cache, parent)
+        return (cache, toks, cum, done, flen, pos + 1), None
+
+    init = (cache, toks0, cum0, done0, flen0, jnp.asarray(plen - 1))
+    (_, toks, cum, _, flen, _), _ = jax.lax.scan(
+        body, init, None, length=max_new_tokens
+    )
+    scored = cum / _lp(flen)
+    best = jnp.argmax(scored, axis=1)
+    out = jnp.take_along_axis(toks, best[:, None, None], axis=1)[:, 0]
+    return out, jnp.take_along_axis(scored, best[:, None], axis=1)[:, 0]

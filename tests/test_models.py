@@ -602,6 +602,102 @@ def test_generate_works_with_flash_trained_model(world):
     assert np.all((np.asarray(out) >= 0) & (np.asarray(out) < 32))
 
 
+def test_beam_search_beam1_matches_greedy(world):
+    from fluxmpi_tpu.models import TransformerLM, beam_search, generate
+
+    lm = TransformerLM(vocab_size=32, max_len=24, num_layers=2, d_model=32,
+                       num_heads=4, d_ff=64)
+    rng = np.random.default_rng(3)
+    prompt = jnp.asarray(rng.integers(0, 32, size=(2, 5)).astype(np.int32))
+    variables = lm.init(jax.random.PRNGKey(0), prompt, train=False)
+
+    greedy = generate(lm, variables, prompt, max_new_tokens=7)
+    toks, scores = beam_search(lm, variables, prompt, max_new_tokens=7,
+                               beam_size=1)
+    assert toks.shape == (2, 12) and scores.shape == (2,)
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(greedy))
+    assert np.all(np.isfinite(np.asarray(scores)))
+
+
+def test_beam_search_finds_global_optimum(world):
+    # With beam_size = vocab**max_new_tokens the search is exhaustive, so
+    # the result must equal the true argmax over all continuations scored
+    # by teacher-forced log-likelihood on the TRAINING forward — an
+    # independent oracle path (full forward, no KV cache).
+    from itertools import product
+
+    from fluxmpi_tpu.models import TransformerLM, beam_search
+
+    vocab, plen, new = 6, 2, 3
+    lm = TransformerLM(vocab_size=vocab, max_len=8, num_layers=1,
+                       d_model=16, num_heads=2, d_ff=32)
+    rng = np.random.default_rng(7)
+    prompt = jnp.asarray(rng.integers(0, vocab, size=(2, plen))
+                         .astype(np.int32))
+    variables = lm.init(jax.random.PRNGKey(2), prompt, train=False)
+
+    best_toks, best_scores = beam_search(
+        lm, variables, prompt, max_new_tokens=new, beam_size=vocab ** new)
+
+    conts = np.array(list(product(range(vocab), repeat=new)), np.int32)
+    n = len(conts)  # 216
+    for row in range(2):
+        seqs = np.concatenate(
+            [np.tile(np.asarray(prompt[row]), (n, 1)), conts], axis=1)
+        logits = lm.apply(variables, jnp.asarray(seqs), train=False)
+        logp = np.asarray(jax.nn.log_softmax(
+            logits.astype(jnp.float32), axis=-1))
+        scores = np.zeros(n)
+        for t in range(plen - 1, plen + new - 1):
+            scores += logp[np.arange(n), t, seqs[:, t + 1]]
+        k = int(np.argmax(scores))
+        np.testing.assert_allclose(float(best_scores[row]), scores[k],
+                                   atol=1e-4, rtol=1e-5)
+        np.testing.assert_array_equal(
+            np.asarray(best_toks[row, plen:]), conts[k])
+
+
+def test_beam_search_eos_absorbing_and_validation(world):
+    from fluxmpi_tpu.models import TransformerLM, beam_search
+
+    vocab = 4
+    lm = TransformerLM(vocab_size=vocab, max_len=12, num_layers=1,
+                       d_model=16, num_heads=2, d_ff=32)
+    prompt = jnp.asarray([[1, 2], [0, 3]], jnp.int32)
+    variables = lm.init(jax.random.PRNGKey(0), prompt, train=False)
+
+    for eos in range(vocab):
+        toks, scores = beam_search(lm, variables, prompt, max_new_tokens=6,
+                                   beam_size=3, eos_token=eos,
+                                   length_penalty=0.6)
+        gen = np.asarray(toks[:, 2:])
+        assert np.all(np.isfinite(np.asarray(scores)))
+        for row in gen:
+            hits = np.flatnonzero(row == eos)
+            if hits.size:  # everything after the first eos is eos
+                assert np.all(row[hits[0]:] == eos)
+        # Returned score == teacher-forced rescoring of the returned
+        # sequence, length-penalized at the finish length (independent
+        # full-forward oracle, no KV cache).
+        hits = np.flatnonzero(gen[0] == eos)
+        flen = int(hits[0]) + 1 if hits.size else 6
+        seq = np.asarray(toks[0:1, :2 + flen])
+        logp = np.asarray(jax.nn.log_softmax(
+            lm.apply(variables, jnp.asarray(seq),
+                     train=False).astype(jnp.float32), axis=-1))
+        raw = sum(logp[0, t, seq[0, t + 1]] for t in range(1, 1 + flen))
+        lp = ((5.0 + flen) / 6.0) ** 0.6
+        np.testing.assert_allclose(float(scores[0]), raw / lp,
+                                   atol=1e-4, rtol=1e-5)
+
+    with pytest.raises(ValueError, match="beam_size"):
+        beam_search(lm, variables, prompt, 4, beam_size=0)
+    with pytest.raises(ValueError, match="max_len"):
+        beam_search(lm, variables, prompt, 100, beam_size=2)
+    with pytest.raises(ValueError, match="vocabulary"):
+        beam_search(lm, variables, prompt, 4, beam_size=2, eos_token=vocab)
+
+
 def test_transformer_hidden_escape_hatch(world):
     # hidden=True exposes (pre-head states, tied table) so custom heads
     # (e.g. the TP vocab-sharded CE) compose; consistent with logits.
