@@ -1,0 +1,31 @@
+#!/bin/bash
+# Relaunch loop for scripts/patient_session.py — the no-kill recovery
+# mode for the axon lease TTL (BENCH_NOTES_r05.md). Rules it encodes:
+#   - NEVER wrap the session in `timeout`: the kill is what arms the
+#     ~1500 s TTL. The session blocks through a TTL-length init and
+#     exits by itself in the erroring-service mode.
+#   - Relaunch after the session exits ON ITS OWN (self-exits — clean
+#     returns AND python exceptions — close the connection gracefully
+#     and do not arm the TTL; only external kills do, and nothing here
+#     kills), until an attempt reaches a real TPU or the cap is hit.
+#   - Success is judged only on lines THIS attempt appended to the
+#     results file (it is append-only across runs).
+# Usage: nohup bash scripts/patient_watch.sh [budget] &
+LOG=/tmp/patient_watch.log
+OUT=/tmp/patient_session.jsonl
+BUDGET=${1:-9000}
+cd "$(dirname "$0")/.." || exit 1
+touch "$OUT"
+for i in $(seq 1 12); do
+  before=$(wc -l < "$OUT")
+  echo "[$(date -u +%H:%M:%S)] patient attempt $i (out lines: $before)" >> "$LOG"
+  python -u scripts/patient_session.py --budget "$BUDGET" --out "$OUT" \
+    >> /tmp/patient_session.log 2>&1
+  rc=$?
+  echo "[$(date -u +%H:%M:%S)] attempt $i exit rc=$rc" >> "$LOG"
+  if tail -n +"$((before + 1))" "$OUT" | grep -q '"platform": "tpu"'; then
+    echo "[$(date -u +%H:%M:%S)] TPU session ran - stopping loop" >> "$LOG"
+    exit 0
+  fi
+  sleep 120
+done
