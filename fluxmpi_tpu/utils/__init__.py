@@ -7,3 +7,10 @@ from .checkpoint import (  # noqa: F401
 )
 from .profiling import profile_trace, step_timer  # noqa: F401
 from .ema import EMAState, ema_init, ema_params, ema_update  # noqa: F401
+from .precision import (  # noqa: F401
+    DynamicLossScale,
+    Policy,
+    all_finite,
+    get_policy,
+    loss_scale_init,
+)

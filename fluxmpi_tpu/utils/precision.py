@@ -1,0 +1,205 @@
+"""Mixed-precision policies and dynamic loss scaling.
+
+Framework extra beyond the reference's scope (its precision story is
+user-land Flux `f32`/`f16` conversion of the model; no policy object or
+loss-scaler exists to mirror — the closest surface is the bf16-leaf
+handling its allreduce staging preserves, src/comm.jl dtype passthrough,
+which `fluxmpi_tpu.comm` already matches). Two pieces:
+
+- :class:`Policy` — jmp-style (param, compute, output) dtype triple with
+  pure-pytree cast helpers. On TPU the canonical policy is
+  ``params=float32, compute=bfloat16, output=float32``: parameters and
+  optimizer state stay f32 (update increments sit below bf16 resolution
+  at realistic learning rates), matmuls/convs run bf16 on the MXU, and
+  reductions/logits return to f32.
+
+- :class:`DynamicLossScale` — the float16 survival kit: scale the loss
+  up before the backward pass, unscale the gradients, halve the scale on
+  inf/nan and grow it back after a run of finite steps. **bfloat16 does
+  not need this** (same exponent range as f32); it exists for f16-style
+  flows and API completeness, and is shaped as a pure state value that
+  jits and donates cleanly inside a train step.
+
+All casts touch only floating-point leaves — integer ids, bool masks,
+and PRNG keys pass through untouched.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "Policy",
+    "get_policy",
+    "DynamicLossScale",
+    "loss_scale_init",
+    "all_finite",
+]
+
+
+def _cast_floating(tree: Any, dtype) -> Any:
+    if dtype is None:
+        return tree
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(dtype)
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x,
+        tree,
+    )
+
+
+class Policy(NamedTuple):
+    """(param, compute, output) dtype triple with pytree cast helpers.
+
+    ``None`` for any slot means "leave as is". Use :func:`get_policy`
+    for the string spelling (``"params=float32,compute=bfloat16,
+    output=float32"`` or the ``"bf16"``/``"f32"`` shorthands).
+    """
+
+    param_dtype: Any = None
+    compute_dtype: Any = None
+    output_dtype: Any = None
+
+    def cast_to_param(self, tree: Any) -> Any:
+        """Float leaves → ``param_dtype`` (checkpoint / init layout)."""
+        return _cast_floating(tree, self.param_dtype)
+
+    def cast_to_compute(self, tree: Any) -> Any:
+        """Float leaves → ``compute_dtype`` (entering the forward)."""
+        return _cast_floating(tree, self.compute_dtype)
+
+    def cast_to_output(self, tree: Any) -> Any:
+        """Float leaves → ``output_dtype`` (leaving the forward)."""
+        return _cast_floating(tree, self.output_dtype)
+
+
+_SHORTHANDS = {
+    # The canonical TPU training policy.
+    "bf16": ("float32", "bfloat16", "float32"),
+    "bfloat16": ("float32", "bfloat16", "float32"),
+    # Full precision (the identity policy, spelled out).
+    "f32": ("float32", "float32", "float32"),
+    "float32": ("float32", "float32", "float32"),
+    # f16 with f32 master params — pair with DynamicLossScale.
+    "f16": ("float32", "float16", "float32"),
+    "float16": ("float32", "float16", "float32"),
+}
+
+
+def get_policy(spec: str) -> Policy:
+    """Parse ``"bf16"`` / ``"f32"`` / ``"f16"`` or the explicit
+    ``"params=<dtype>,compute=<dtype>,output=<dtype>"`` form (any subset
+    of the three keys; omitted slots mean "leave as is")."""
+    spec = spec.strip().lower()
+    if spec in _SHORTHANDS:
+        p, c, o = _SHORTHANDS[spec]
+        return Policy(jnp.dtype(p), jnp.dtype(c), jnp.dtype(o))
+    slots = {"params": None, "compute": None, "output": None}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, sep, value = part.partition("=")
+        key = key.strip()
+        if not sep or key not in slots:
+            raise ValueError(
+                f"bad policy spec {spec!r}: expected 'params=<dtype>,"
+                f"compute=<dtype>,output=<dtype>' (any subset) or one of "
+                f"{sorted(set(_SHORTHANDS))}"
+            )
+        if slots[key] is not None:
+            raise ValueError(f"bad policy spec {spec!r}: duplicate {key!r}")
+        try:
+            slots[key] = jnp.dtype(value.strip())
+        except TypeError as e:
+            raise ValueError(
+                f"bad policy spec {spec!r}: {value.strip()!r} is not a "
+                f"dtype (use full numpy/jax names, e.g. 'bfloat16', "
+                f"'float16', 'float32')"
+            ) from e
+    if all(v is None for v in slots.values()):
+        raise ValueError(f"bad policy spec {spec!r}: no slots given")
+    return Policy(slots["params"], slots["compute"], slots["output"])
+
+
+def all_finite(tree: Any) -> jax.Array:
+    """Scalar bool: every float leaf is free of inf/nan (the gradient
+    health check the loss scaler keys on)."""
+    leaves = [
+        jnp.isfinite(x).all()
+        for x in jax.tree_util.tree_leaves(tree)
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+    ]
+    if not leaves:
+        return jnp.asarray(True)
+    return jnp.stack(leaves).all()
+
+
+class DynamicLossScale(NamedTuple):
+    """Pure loss-scale state — arrays only, so it lives inside a jitted
+    (and donated) train step without host round trips.
+
+    Protocol per step::
+
+        scaled_loss = ls.scale_loss(loss)       # before value_and_grad
+        grads = ls.unscale(grads)               # after
+        finite = all_finite(grads)
+        ls = ls.adjust(finite)                  # halve on overflow, grow
+        # apply the update only where `finite` (jnp.where on the trees)
+
+    Growth doubles the scale after ``growth_interval`` consecutive
+    finite steps (counter in the state); overflow halves it immediately
+    and resets the counter. The scale is clamped to ``[1, 2**24]``.
+    """
+
+    scale: jax.Array  # f32 scalar
+    counter: jax.Array  # i32 scalar: consecutive finite steps
+    growth_interval: jax.Array  # i32 scalar (static-ish, rides the state)
+
+    def scale_loss(self, loss: jax.Array) -> jax.Array:
+        # Multiply in f32: an f16 loss would overflow at scale >= 2**16
+        # (f16 max 65504), turning every scale-growth step into a fake
+        # overflow. The f32 return is what the backward wants anyway.
+        return loss.astype(jnp.float32) * self.scale
+
+    def unscale(self, tree: Any) -> Any:
+        inv = (1.0 / self.scale).astype(jnp.float32)
+        return jax.tree_util.tree_map(
+            lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype)
+            if jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating) else g,
+            tree,
+        )
+
+    def adjust(self, grads_finite: jax.Array) -> "DynamicLossScale":
+        counter = jnp.where(grads_finite, self.counter + 1, 0)
+        grown = jnp.where(
+            counter >= self.growth_interval, self.scale * 2.0, self.scale
+        )
+        counter = jnp.where(counter >= self.growth_interval, 0, counter)
+        scale = jnp.where(grads_finite, grown, self.scale * 0.5)
+        scale = jnp.clip(scale, 1.0, 2.0 ** 24)
+        return DynamicLossScale(
+            scale=scale.astype(jnp.float32),
+            counter=counter.astype(jnp.int32),
+            growth_interval=self.growth_interval,
+        )
+
+
+def loss_scale_init(
+    initial: float = 2.0 ** 15, growth_interval: int = 2000
+) -> DynamicLossScale:
+    """Fresh :class:`DynamicLossScale` (defaults follow the common AMP
+    recipe: start at 2^15, double after 2000 clean steps)."""
+    if initial < 1:
+        raise ValueError(f"initial scale must be >= 1, got {initial}")
+    if growth_interval < 1:
+        raise ValueError(
+            f"growth_interval must be >= 1, got {growth_interval}"
+        )
+    return DynamicLossScale(
+        scale=jnp.asarray(float(initial), jnp.float32),
+        counter=jnp.asarray(0, jnp.int32),
+        growth_interval=jnp.asarray(int(growth_interval), jnp.int32),
+    )

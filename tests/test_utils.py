@@ -418,3 +418,152 @@ def test_ema_state_checkpoints(world, tmp_path):
     assert int(restored.count) == 3
     np.testing.assert_allclose(np.asarray(ema_params(restored)["w"]),
                                np.asarray(ema_params(ema)["w"]), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Mixed precision: Policy casts + dynamic loss scaling
+# ---------------------------------------------------------------------------
+
+
+def test_policy_casts_only_float_leaves(world):
+    from fluxmpi_tpu.utils import Policy, get_policy
+
+    tree = {
+        "w": jnp.ones((2, 2), jnp.float32),
+        "ids": jnp.arange(3, dtype=jnp.int32),
+        "mask": jnp.ones((2,), bool),
+    }
+    pol = get_policy("bf16")
+    comp = pol.cast_to_compute(tree)
+    assert comp["w"].dtype == jnp.bfloat16
+    assert comp["ids"].dtype == jnp.int32  # untouched
+    assert comp["mask"].dtype == bool  # untouched
+    back = pol.cast_to_param(comp)
+    assert back["w"].dtype == jnp.float32
+    out = pol.cast_to_output({"logits": jnp.ones((2,), jnp.bfloat16)})
+    assert out["logits"].dtype == jnp.float32
+
+    # None slots are the identity.
+    ident = Policy()
+    same = ident.cast_to_compute(tree)
+    assert same["w"].dtype == jnp.float32
+
+
+def test_get_policy_parsing(world):
+    from fluxmpi_tpu.utils import get_policy
+
+    pol = get_policy("params=float32,compute=bfloat16,output=float32")
+    assert pol.param_dtype == jnp.float32
+    assert pol.compute_dtype == jnp.bfloat16
+    assert pol.output_dtype == jnp.float32
+
+    # Subset: only compute pinned; other slots stay None (leave as is).
+    sub = get_policy("compute=bfloat16")
+    assert sub.param_dtype is None and sub.output_dtype is None
+    assert sub.compute_dtype == jnp.bfloat16
+
+    f16 = get_policy("f16")
+    assert f16.compute_dtype == jnp.float16
+
+    with pytest.raises(ValueError, match="bad policy spec"):
+        get_policy("speed=maximum")
+    with pytest.raises(ValueError, match="duplicate"):
+        get_policy("compute=bfloat16,compute=float16")
+    with pytest.raises(ValueError, match="no slots"):
+        get_policy(" , ,")
+
+
+def test_all_finite(world):
+    from fluxmpi_tpu.utils import all_finite
+
+    good = {"a": jnp.ones((3,)), "n": jnp.arange(2, dtype=jnp.int32)}
+    assert bool(all_finite(good))
+    assert bool(all_finite({"ints_only": jnp.arange(2)}))
+    bad = {"a": jnp.asarray([1.0, jnp.inf])}
+    assert not bool(all_finite(bad))
+    nan = {"a": jnp.asarray([jnp.nan])}
+    assert not bool(all_finite(nan))
+
+
+def test_dynamic_loss_scale_state_machine(world):
+    from fluxmpi_tpu.utils import all_finite, loss_scale_init
+
+    ls = loss_scale_init(initial=2.0 ** 4, growth_interval=3)
+    assert float(ls.scale) == 16.0
+
+    # Overflow halves immediately and resets the counter.
+    ls2 = ls.adjust(jnp.asarray(False))
+    assert float(ls2.scale) == 8.0 and int(ls2.counter) == 0
+
+    # growth_interval consecutive finite steps double the scale.
+    cur = ls
+    for _ in range(3):
+        cur = cur.adjust(jnp.asarray(True))
+    assert float(cur.scale) == 32.0 and int(cur.counter) == 0
+
+    # Clamp floor at 1.0.
+    low = loss_scale_init(initial=1.0, growth_interval=10)
+    low = low.adjust(jnp.asarray(False))
+    assert float(low.scale) == 1.0
+
+    # scale_loss / unscale round-trip; int leaves pass unscale untouched.
+    grads = {"w": jnp.full((2,), 4.0), "step": jnp.asarray(7, jnp.int32)}
+    scaled = ls.scale_loss(jnp.asarray(2.0))
+    assert float(scaled) == 32.0
+    un = ls.unscale(grads)
+    np.testing.assert_allclose(np.asarray(un["w"]), 0.25)
+    assert un["step"].dtype == jnp.int32 and int(un["step"]) == 7
+    assert bool(all_finite(grads))
+
+    with pytest.raises(ValueError, match="initial"):
+        loss_scale_init(initial=0.5)
+    with pytest.raises(ValueError, match="growth_interval"):
+        loss_scale_init(growth_interval=0)
+
+
+def test_loss_scale_inside_jitted_step(world):
+    # The scaler is pure state: a full scale->grad->unscale->adjust step
+    # jits, and a manufactured overflow skips the (where-gated) update.
+    from fluxmpi_tpu.utils import all_finite, loss_scale_init
+
+    def loss_fn(w, x):
+        return jnp.sum((w * x) ** 2)
+
+    @jax.jit
+    def step(w, ls, x):
+        loss, grads = jax.value_and_grad(
+            lambda w: ls.scale_loss(loss_fn(w, x)))(w)
+        grads = ls.unscale(grads)
+        finite = all_finite(grads)
+        new_w = jnp.where(finite, w - 0.1 * grads, w)
+        return new_w, ls.adjust(finite), loss
+
+    w = jnp.ones((4,))
+    ls = loss_scale_init(initial=4.0, growth_interval=100)
+    w1, ls1, _ = step(w, ls, jnp.ones((4,)))
+    assert not np.allclose(np.asarray(w1), np.asarray(w))  # update applied
+    assert float(ls1.scale) == 4.0 and int(ls1.counter) == 1
+
+    w2, ls2, _ = step(w1, ls1, jnp.full((4,), jnp.inf))  # overflow batch
+    np.testing.assert_array_equal(np.asarray(w2), np.asarray(w1))  # skipped
+    assert float(ls2.scale) == 2.0 and int(ls2.counter) == 0
+
+
+def test_loss_scale_f16_loss_no_overflow(world):
+    # An f16 loss must not overflow the scaled product at scale >= 2**16
+    # (the multiply happens in f32; f16 max is 65504).
+    from fluxmpi_tpu.utils import loss_scale_init
+
+    ls = loss_scale_init(initial=2.0 ** 17, growth_interval=5)
+    scaled = ls.scale_loss(jnp.asarray(1.5, jnp.float16))
+    assert scaled.dtype == jnp.float32
+    assert np.isfinite(float(scaled)) and float(scaled) == 1.5 * 2.0 ** 17
+
+
+def test_get_policy_bad_dtype_value(world):
+    from fluxmpi_tpu.utils import get_policy
+
+    with pytest.raises(ValueError, match="not a dtype"):
+        get_policy("compute=bf16")  # shorthand names are not dtype names
+    with pytest.raises(ValueError, match="not a dtype"):
+        get_policy("compute=")
