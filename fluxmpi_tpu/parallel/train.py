@@ -118,6 +118,7 @@ def make_train_step(
     remat: bool = False,
     grad_accum_steps: int = 1,
     scan_steps: int = 1,
+    policy: Any | None = None,
 ) -> Callable[[TrainState, Any], tuple[TrainState, jax.Array]]:
     """Build a compiled data-parallel train step.
 
@@ -172,6 +173,15 @@ def make_train_step(
         launches are host-driven by construction). Composes with
         ``grad_accum_steps`` (accumulation nests inside each scanned
         update). ``style="auto"`` only.
+      policy: optional :class:`fluxmpi_tpu.utils.Policy` — the params are
+        cast to its ``compute_dtype`` ENTERING ``loss_fn`` while the
+        :class:`TrainState` keeps full-precision masters (the cast's vjp
+        returns the gradient cotangent to the master dtype, so the
+        optimizer update runs in f32). Batch leaves are left alone —
+        cast inputs inside ``loss_fn`` where you know which leaves are
+        images vs integer ids (``policy.cast_to_compute`` touches only
+        float leaves, so passing the whole batch through it is usually
+        right).
 
     Returns:
       ``step(state, batch) -> (new_state, loss)`` — compiled, collective
@@ -185,6 +195,12 @@ def make_train_step(
         raise ValueError("style must be 'auto' or 'shard_map'")
     if grad_reduce not in ("mean", "sum", None):
         raise ValueError("grad_reduce must be 'mean', 'sum', or None")
+
+    if policy is not None:
+        inner_loss = loss_fn
+
+        def loss_fn(p, mstate, batch):  # noqa: F811 - deliberate rewrap
+            return inner_loss(policy.cast_to_compute(p), mstate, batch)
 
     if remat:
         if remat == "dots":
@@ -335,6 +351,7 @@ def make_eval_step(
     axis_name: str | None = None,
     state_sharding: Any | None = None,
     batch_spec: P | None = None,
+    policy: Any | None = None,
 ) -> Callable[[TrainState, Any], Any]:
     """Build a compiled evaluation step: ``eval_step(state, batch) ->
     metrics``.
@@ -347,13 +364,17 @@ def make_eval_step(
     treatment as training here).
 
     ``state_sharding`` / ``batch_spec`` mirror :func:`make_train_step` so an
-    FSDP/TP-sharded :class:`TrainState` evaluates in its training layout.
+    FSDP/TP-sharded :class:`TrainState` evaluates in its training layout;
+    ``policy`` casts the params to its compute dtype entering
+    ``metric_fn``, same as training.
     """
     mesh = mesh or global_mesh()
     name = axis_name or config.DP_AXIS_NAME
 
     def step(ts: TrainState, batch):
-        return metric_fn(ts.params, ts.model_state, batch)
+        params = ts.params if policy is None else policy.cast_to_compute(
+            ts.params)
+        return metric_fn(params, ts.model_state, batch)
 
     replicated = NamedSharding(mesh, P())
     state_in = replicated if state_sharding is None else state_sharding

@@ -43,11 +43,13 @@ __all__ = [
 def _cast_floating(tree: Any, dtype) -> Any:
     if dtype is None:
         return tree
-    return jax.tree_util.tree_map(
-        lambda x: x.astype(dtype)
-        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x,
-        tree,
-    )
+
+    def cast(x):
+        arr = jnp.asarray(x)  # plain Python floats have no .astype
+        return arr.astype(dtype) if jnp.issubdtype(
+            arr.dtype, jnp.floating) else x
+
+    return jax.tree_util.tree_map(cast, tree)
 
 
 class Policy(NamedTuple):
@@ -166,11 +168,14 @@ class DynamicLossScale(NamedTuple):
 
     def unscale(self, tree: Any) -> Any:
         inv = (1.0 / self.scale).astype(jnp.float32)
-        return jax.tree_util.tree_map(
-            lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype)
-            if jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating) else g,
-            tree,
-        )
+
+        def un(g):
+            arr = jnp.asarray(g)  # plain Python floats have no .astype
+            if not jnp.issubdtype(arr.dtype, jnp.floating):
+                return g
+            return (arr.astype(jnp.float32) * inv).astype(arr.dtype)
+
+        return jax.tree_util.tree_map(un, tree)
 
     def adjust(self, grads_finite: jax.Array) -> "DynamicLossScale":
         counter = jnp.where(grads_finite, self.counter + 1, 0)

@@ -361,3 +361,47 @@ def test_scan_steps_composes_with_fsdp_sharding(world):
         ),
         jax.device_get(s1.params), jax.device_get(s2.params),
     )
+
+
+def test_policy_casts_params_entering_loss(world):
+    # policy= : the loss sees compute-dtype params, the TrainState keeps
+    # f32 masters, gradients/updates run f32, and training still works.
+    import fluxmpi_tpu as fm
+    from fluxmpi_tpu.parallel import make_train_step
+    from fluxmpi_tpu.parallel.train import replicate, shard_batch
+    from fluxmpi_tpu.utils import get_policy
+
+    model, params, optimizer, state, _, batch = _setup(world)
+    seen = []
+
+    def loss_fn(p, mstate, b):
+        x, y = b
+        seen.append(jax.tree_util.tree_leaves(p)[0].dtype)
+        pred = model.apply(p, x.astype(jnp.bfloat16))
+        return jnp.mean((pred.astype(jnp.float32) - y) ** 2), mstate
+
+    step = make_train_step(loss_fn, optimizer, style="auto", donate=False,
+                           policy=get_policy("bf16"))
+    st = replicate(state)
+    data = shard_batch(batch)
+    for _ in range(40):
+        st, loss = step(st, data)
+    assert seen and all(d == jnp.bfloat16 for d in seen)  # compute dtype
+    leaves = jax.tree_util.tree_leaves(st.params)
+    assert all(x.dtype == jnp.float32 for x in leaves)  # f32 masters
+    assert float(loss) < 1.0  # learns through the cast
+
+    # Eval step gets the same cast.
+    from fluxmpi_tpu.parallel.train import make_eval_step
+
+    eval_seen = []
+
+    def metric_fn(p, mstate, b):
+        x, y = b
+        eval_seen.append(jax.tree_util.tree_leaves(p)[0].dtype)
+        pred = model.apply(p, x.astype(jnp.bfloat16))
+        return jnp.mean((pred.astype(jnp.float32) - y) ** 2)
+
+    ev = make_eval_step(metric_fn, policy=get_policy("bf16"))
+    _ = ev(st, data)
+    assert eval_seen and eval_seen[0] == jnp.bfloat16

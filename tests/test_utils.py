@@ -567,3 +567,23 @@ def test_get_policy_bad_dtype_value(world):
         get_policy("compute=bf16")  # shorthand names are not dtype names
     with pytest.raises(ValueError, match="not a dtype"):
         get_policy("compute=")
+
+
+def test_policy_and_unscale_handle_python_float_leaves(world):
+    # The API invites casting whole batch trees; plain Python float
+    # leaves (e.g. a smoothing constant riding in the batch dict) must
+    # cast, not crash.
+    from fluxmpi_tpu.utils import get_policy, loss_scale_init
+
+    pol = get_policy("bf16")
+    tree = {"x": jnp.ones((2,), jnp.float32), "alpha": 0.1, "k": 3}
+    out = pol.cast_to_compute(tree)
+    assert out["x"].dtype == jnp.bfloat16
+    assert out["alpha"].dtype == jnp.bfloat16  # Python float -> array
+    assert out["k"] == 3  # Python int untouched
+
+    ls = loss_scale_init(initial=4.0)
+    un = ls.unscale({"g": jnp.ones((2,)), "aux": 2.0, "n": 5})
+    np.testing.assert_allclose(np.asarray(un["g"]), 0.25)
+    np.testing.assert_allclose(float(un["aux"]), 0.5)
+    assert un["n"] == 5
