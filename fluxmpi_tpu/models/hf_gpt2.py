@@ -1,0 +1,162 @@
+"""Import HuggingFace GPT-2 checkpoints into :class:`TransformerLM`.
+
+Interop surface beyond the reference's scope (its models are user-land
+Flux code; no checkpoint importer exists to mirror) — the "switch to
+this framework" story made concrete: any `transformers`
+``GPT2LMHeadModel`` (randomly initialized or pretrained) converts to a
+``TransformerLM`` + params pytree whose forward reproduces the torch
+logits, and then trains/decodes through every fluxmpi_tpu path (DP/FSDP
+sharding, flash attention, fused CE head, ``generate``/``beam_search``).
+
+The architectures line up exactly:
+
+- pre-LN blocks, final LayerNorm, learned positions, weight-tied head;
+- GPT-2's ``gelu_new`` == the tanh-approximate GELU flax uses by
+  default (``nn.gelu(approximate=True)``);
+- HF ``Conv1D`` stores weights ``[in, out]`` — flax ``Dense`` kernel
+  orientation, so MLP weights map with NO transpose; the fused
+  ``c_attn`` ``[d, 3d]`` splits into flax's per-head
+  ``query/key/value`` DenseGeneral kernels ``[d, heads, head_dim]``
+  (and ``c_proj`` reshapes to the ``out`` kernel ``[heads, head_dim,
+  d]``);
+- GPT-2's LayerNorm epsilon (1e-5) rides in ``TransformerLM(ln_eps=)``.
+
+The converted tree is structurally validated against the model's own
+``init`` (``jax.eval_shape`` — no FLOPs), so any future drift between
+the two architectures fails loudly at conversion time, not as silently
+wrong logits. Logit-level parity against the torch forward is pinned by
+``tests/test_hf_import.py``.
+
+torch / transformers are imported lazily — the module costs nothing
+unless used.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .transformer import TransformerLM
+
+__all__ = ["lm_from_gpt2"]
+
+
+def _tree_shapes(tree: Any) -> Any:
+    return jax.tree_util.tree_map(lambda x: tuple(x.shape), tree)
+
+
+def lm_from_gpt2(hf_model) -> tuple[TransformerLM, dict]:
+    """Convert a ``transformers.GPT2LMHeadModel`` to
+    ``(TransformerLM, {"params": ...})``.
+
+    The returned model is the float32 training configuration
+    (``dropout=0`` — HF's dropout only matters in torch train mode);
+    clone with ``dtype=jnp.bfloat16`` / an ``attention_fn`` for TPU
+    training, or feed it straight to ``generate``/``beam_search``.
+
+    Raises ``ValueError`` if the converted tree's structure or shapes
+    disagree with the architecture's own init — the drift guard.
+    """
+    cfg = hf_model.config
+    # The mapping assumes GPT-2's stock computation. Shape checks cannot
+    # catch these knobs, so reject them explicitly — the alternative is
+    # silently wrong logits.
+    unsupported = {
+        "activation_function": (
+            getattr(cfg, "activation_function", "gelu_new"),
+            ("gelu_new", "gelu_pytorch_tanh"),
+        ),
+        "tie_word_embeddings": (
+            getattr(cfg, "tie_word_embeddings", True), (True,)),
+        "scale_attn_weights": (
+            getattr(cfg, "scale_attn_weights", True), (True,)),
+        "scale_attn_by_inverse_layer_idx": (
+            getattr(cfg, "scale_attn_by_inverse_layer_idx", False),
+            (False,)),
+        "reorder_and_upcast_attn": (
+            getattr(cfg, "reorder_and_upcast_attn", False), (False,)),
+    }
+    for knob, (value, allowed) in unsupported.items():
+        if value not in allowed:
+            raise ValueError(
+                f"lm_from_gpt2 supports stock GPT-2 computation only: "
+                f"config.{knob}={value!r} (supported: {allowed})"
+            )
+    sd = {
+        k: np.asarray(v.detach().cpu().numpy())
+        for k, v in hf_model.state_dict().items()
+    }
+    d, heads = int(cfg.n_embd), int(cfg.n_head)
+    if d % heads:
+        raise ValueError(f"n_embd {d} not divisible by n_head {heads}")
+    hd = d // heads
+    d_ff = int(cfg.n_inner) if cfg.n_inner else 4 * d
+    model = TransformerLM(
+        vocab_size=int(cfg.vocab_size),
+        max_len=int(cfg.n_positions),
+        num_layers=int(cfg.n_layer),
+        d_model=d,
+        num_heads=heads,
+        d_ff=d_ff,
+        dropout=0.0,
+        dtype=jnp.float32,
+        ln_eps=float(cfg.layer_norm_epsilon),
+    )
+
+    def ln(prefix: str) -> dict:
+        return {"scale": sd[prefix + ".weight"], "bias": sd[prefix + ".bias"]}
+
+    enc: dict = {}
+    for i in range(int(cfg.n_layer)):
+        p = f"transformer.h.{i}"
+        qkv_w = sd[f"{p}.attn.c_attn.weight"]  # [d, 3d], in→out like flax
+        qkv_b = sd[f"{p}.attn.c_attn.bias"]  # [3d]
+        qw, kw, vw = np.split(qkv_w, 3, axis=1)
+        qb, kb, vb = np.split(qkv_b, 3)
+        enc[f"block_{i}"] = {
+            "ln1": ln(f"{p}.ln_1"),
+            "attn": {
+                "query": {"kernel": qw.reshape(d, heads, hd),
+                          "bias": qb.reshape(heads, hd)},
+                "key": {"kernel": kw.reshape(d, heads, hd),
+                        "bias": kb.reshape(heads, hd)},
+                "value": {"kernel": vw.reshape(d, heads, hd),
+                          "bias": vb.reshape(heads, hd)},
+                "out": {"kernel":
+                        sd[f"{p}.attn.c_proj.weight"].reshape(heads, hd, d),
+                        "bias": sd[f"{p}.attn.c_proj.bias"]},
+            },
+            "ln2": ln(f"{p}.ln_2"),
+            "ff1": {"kernel": sd[f"{p}.mlp.c_fc.weight"],
+                    "bias": sd[f"{p}.mlp.c_fc.bias"]},
+            "ff2": {"kernel": sd[f"{p}.mlp.c_proj.weight"],
+                    "bias": sd[f"{p}.mlp.c_proj.bias"]},
+        }
+    enc["ln_out"] = ln("transformer.ln_f")
+    params = {
+        "embed": {"embedding": sd["transformer.wte.weight"]},
+        "pos_embed": sd["transformer.wpe.weight"],
+        "encoder": enc,
+    }
+    params = jax.tree_util.tree_map(
+        lambda x: jnp.asarray(x, jnp.float32), params
+    )
+
+    # Drift guard: the converted tree must agree leaf-for-leaf with what
+    # this architecture initializes (shapes via eval_shape — no FLOPs).
+    ref = jax.eval_shape(
+        lambda: model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 2), jnp.int32),
+            train=False,
+        )["params"]
+    )
+    got, want = _tree_shapes(params), _tree_shapes(ref)
+    if got != want:
+        raise ValueError(
+            "converted GPT-2 tree does not match TransformerLM.init: "
+            f"converted {got} vs expected {want}"
+        )
+    return model, {"params": params}

@@ -421,6 +421,7 @@ class MoEEncoder(TransformerEncoder):
             dtype=self.dtype,
             attention_fn=self.attention_fn,
             decode=self.decode,
+            ln_eps=self.ln_eps,
             num_experts=self.num_experts,
             capacity_factor=self.capacity_factor,
             n_groups=self.n_groups,
@@ -472,6 +473,7 @@ class MoETransformerLM(TransformerLM):
             dtype=self.dtype,
             attention_fn=self.attention_fn,
             decode=self.decode,
+            ln_eps=self.ln_eps,
             num_experts=self.num_experts,
             capacity_factor=self.capacity_factor,
             n_groups=self.n_groups,

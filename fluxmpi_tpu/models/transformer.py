@@ -33,6 +33,7 @@ class EncoderBlock(nn.Module):
     dtype: jnp.dtype
     attention_fn: Callable | None = None
     decode: bool = False
+    ln_eps: float = 1e-6
 
     def make_ff(self) -> nn.Module | None:
         """Hook: return a module for the feed-forward sublayer (called as
@@ -49,7 +50,7 @@ class EncoderBlock(nn.Module):
         # is a training-time kernel and is bypassed at decode.
         if self.attention_fn is not None and not self.decode:
             attn_kwargs["attention_fn"] = self.attention_fn
-        h = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
+        h = nn.LayerNorm(epsilon=self.ln_eps, dtype=self.dtype, name="ln1")(x)
         h = nn.MultiHeadDotProductAttention(
             num_heads=self.num_heads,
             dtype=self.dtype,
@@ -60,7 +61,7 @@ class EncoderBlock(nn.Module):
             **attn_kwargs,
         )(h, h, mask=mask)
         x = x + h
-        h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        h = nn.LayerNorm(epsilon=self.ln_eps, dtype=self.dtype, name="ln2")(x)
         ff = self.make_ff()
         if ff is None:
             h = nn.Dense(self.d_ff, dtype=self.dtype, name="ff1")(h)
@@ -83,6 +84,7 @@ class TransformerEncoder(nn.Module):
     dtype: jnp.dtype = jnp.float32
     attention_fn: Callable | None = None
     decode: bool = False
+    ln_eps: float = 1e-6
 
     def make_block(self, i: int) -> nn.Module:
         """Hook: build encoder block ``i`` (subclasses swap the block type)."""
@@ -94,6 +96,7 @@ class TransformerEncoder(nn.Module):
             dtype=self.dtype,
             attention_fn=self.attention_fn,
             decode=self.decode,
+            ln_eps=self.ln_eps,
             name=f"block_{i}",
         )
 
@@ -102,7 +105,7 @@ class TransformerEncoder(nn.Module):
         x = x.astype(self.dtype)
         for i in range(self.num_layers):
             x = self.make_block(i)(x, train=train, mask=mask)
-        return nn.LayerNorm(dtype=jnp.float32, name="ln_out")(x)
+        return nn.LayerNorm(epsilon=self.ln_eps, dtype=jnp.float32, name="ln_out")(x)
 
 
 class TransformerLM(nn.Module):
@@ -120,6 +123,7 @@ class TransformerLM(nn.Module):
     dtype: jnp.dtype = jnp.float32
     attention_fn: Callable | None = None
     decode: bool = False
+    ln_eps: float = 1e-6
 
     def make_encoder(self) -> nn.Module:
         """Hook: build the encoder stack (subclasses swap the block type)."""
@@ -132,6 +136,7 @@ class TransformerLM(nn.Module):
             dtype=self.dtype,
             attention_fn=self.attention_fn,
             decode=self.decode,
+            ln_eps=self.ln_eps,
             name="encoder",
         )
 
