@@ -183,6 +183,17 @@ class DistributedDataLoader:
         at once — for very large vision batches pass ``prefetch=1`` or
         ``0`` (see docs/gotchas.md, "Prefetch holds extra batches on
         device").
+      transform: optional host-side hook applied to each assembled LOCAL
+        batch (numpy) before the device transfer — the
+        normalization/augmentation point, running on this process's CPU
+        while the device executes the previous step (it composes with
+        both prefetchers). Either ``transform(batch)`` or
+        ``transform(batch, rng)``; the 2-arg form receives a
+        ``np.random.Generator`` seeded from (seed, epoch, batch index,
+        process index) — augmentations reproduce exactly across
+        checkpoint resume (``set_epoch``) and draw independently on
+        every process. Must preserve each leaf's leading (batch)
+        dimension (checked).
     """
 
     def __init__(
@@ -197,6 +208,7 @@ class DistributedDataLoader:
         seed: int = 0,
         drop_last: bool = True,
         prefetch: int = 2,
+        transform: Any = None,
     ):
         if global_shuffle and not isinstance(data, DistributedDataContainer):
             raise ValueError(
@@ -236,6 +248,28 @@ class DistributedDataLoader:
         if prefetch < 0:
             raise ValueError(f"prefetch must be >= 0, got {prefetch}")
         self.prefetch = prefetch
+        # Host-side augmentation hook — contract in the class docstring.
+        self.transform = transform
+        if transform is None:
+            self._transform_arity = 0
+        else:
+            if not callable(transform):
+                raise ValueError("transform must be callable")
+            import inspect
+
+            try:
+                params = inspect.signature(transform).parameters.values()
+                # Only REQUIRED positional params decide the call shape:
+                # f(batch, eps=1e-6) or f(batch, *, training=False) is a
+                # 1-arg transform, not a request for the rng.
+                required = sum(
+                    1 for p in params
+                    if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+                    and p.default is p.empty
+                )
+            except (TypeError, ValueError):  # builtins, C callables
+                required = 1
+            self._transform_arity = 2 if required >= 2 else 1
         self._epoch = 0
         # Per-process shard sizes can differ (ceil partition, remainder on
         # the last rank). jax.make_array_from_process_local_data is a
@@ -343,6 +377,7 @@ class DistributedDataLoader:
                 rng = np.random.default_rng(self.seed + self._epoch)
                 rng.shuffle(order)
             backing = self._array_backing()
+        epoch_now = self._epoch  # the epoch the shuffle rngs above used
         self._epoch += 1
         sharding = self._sharding()
 
@@ -355,6 +390,36 @@ class DistributedDataLoader:
                 ),
                 batch,
             )
+
+        def _lead_dims(tree):
+            # None marks a 0-d leaf (no batch dim) so the mismatch check
+            # reports it instead of crashing on shape[0].
+            return {
+                tuple(path): (arr.shape[0] if arr.ndim else None)
+                for path, arr in (
+                    (p, np.asarray(x))
+                    for p, x in jax.tree_util.tree_flatten_with_path(tree)[0]
+                )
+            }
+
+        def _transformed(batch, b):
+            if self.transform is None:
+                return batch
+            before = _lead_dims(batch)
+            if self._transform_arity == 2:
+                rng = np.random.default_rng(
+                    [self.seed, epoch_now, b, jax.process_index()]
+                )
+                out = self.transform(batch, rng)
+            else:
+                out = self.transform(batch)
+            after = _lead_dims(out)
+            if before != after:
+                raise ValueError(
+                    "transform must preserve every leaf's leading (batch) "
+                    f"dimension; got {after} from {before}"
+                )
+            return out
 
         if backing is not None:
             # Native fast path: one C++ prefetcher per array leaf assembles
@@ -374,17 +439,17 @@ class DistributedDataLoader:
                     iter(NativePrefetcher(leaf, epoch_order, lbs))
                     for leaf in leaves
                 ]
-                for leaf_batches in zip(*prefetchers):
+                for b, leaf_batches in enumerate(zip(*prefetchers)):
                     batch = jax.tree_util.tree_unflatten(
                         treedef, list(leaf_batches)
                     )
-                    yield _globalize(batch)
+                    yield _globalize(_transformed(batch, b))
             if nbatches > full:
                 tail = order[full * lbs : self._common_len] + offset
                 batch = jax.tree_util.tree_unflatten(
                     treedef, [gather_rows(leaf, tail) for leaf in leaves]
                 )
-                yield _globalize(batch)
+                yield _globalize(_transformed(batch, full))
             return
 
         for b in range(nbatches):
@@ -395,7 +460,7 @@ class DistributedDataLoader:
             stop = min((b + 1) * self.local_batch_size, self._common_len)
             idxs = order[b * self.local_batch_size : stop]
             batch = _stack_samples([source[int(i)] for i in idxs])
-            yield _globalize(batch)
+            yield _globalize(_transformed(batch, b))
 
 
 def scan_batches(
