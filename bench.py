@@ -44,6 +44,9 @@ Env knobs:
   FLUXMPI_TPU_BENCH_PROBE_TIMEOUTS  comma list of probe timeouts (s)
   FLUXMPI_TPU_BENCH_DEVICES   child uses only the first N devices
   FLUXMPI_TPU_COMPILE_CACHE   persistent XLA compile cache dir
+  FLUXMPI_TPU_BENCH_JSONL     also emit results through the telemetry
+                              JSONL sink at this path (schema-validated
+                              by scripts/check_metrics_schema.py)
 """
 
 from __future__ import annotations
@@ -1060,6 +1063,42 @@ def _run_scaling(
     }
 
 
+def _emit_telemetry(result: dict) -> None:
+    """Mirror the headline result through the telemetry sink layer (one
+    JSONL line, fluxmpi_tpu.telemetry schema) when FLUXMPI_TPU_BENCH_JSONL
+    is set. The stdout JSON contract is untouched — this is the same
+    record riding the same pipe every other metric in the system uses, so
+    one tail/validator covers training runs and bench runs alike."""
+    path = os.environ.get("FLUXMPI_TPU_BENCH_JSONL")
+    if not path:
+        return
+    try:
+        from fluxmpi_tpu.telemetry import JSONLSink, MetricsRegistry
+
+        reg = MetricsRegistry(sinks=[JSONLSink(path)])
+        labels = {
+            k: str(result[k])
+            for k in ("platform", "device_kind")
+            if k in result
+        }
+        reg.gauge("bench." + result["metric"], **labels).set(
+            float(result["value"])
+        )
+        if "mfu" in result:
+            reg.gauge("bench.mfu", **labels).set(float(result["mfu"]))
+        scaling = result.get("scaling")
+        if isinstance(scaling, dict) and "scaling_efficiency" in scaling:
+            reg.gauge("bench.scaling_efficiency", **labels).set(
+                float(scaling["scaling_efficiency"])
+            )
+        # The full result rides along so the JSONL line alone reconstructs
+        # the run (validated as a bench record by check_metrics_schema).
+        reg.flush(bench=result)
+        reg.close(flush=False)
+    except Exception as exc:  # emission must never sink the bench run
+        print(f"bench: telemetry emit failed: {exc!r}", file=sys.stderr)
+
+
 def main() -> None:
     t_start = time.monotonic()
     budget = float(
@@ -1086,11 +1125,11 @@ def main() -> None:
             **dict(_CONFIGS), "unet": 900.0,
         }.get(forced, 300.0)
         result = _run_child(forced, child_to, platform)
-        if result is not None:
-            print(json.dumps(result))
-            return
-        print(json.dumps({"metric": "bench_failed", "value": 0.0,
-                          "unit": "none", "vs_baseline": 0.0}))
+        if result is None:
+            result = {"metric": "bench_failed", "value": 0.0,
+                      "unit": "none", "vs_baseline": 0.0}
+        _emit_telemetry(result)
+        print(json.dumps(result))
         return
 
     # Phase 1: probe the accelerator — platform variants × timeouts with
@@ -1183,6 +1222,7 @@ def main() -> None:
         if scaling is not None:
             result["scaling"] = scaling
 
+    _emit_telemetry(result)
     print(json.dumps(result))
 
 

@@ -17,9 +17,13 @@ Public surface (parity with reference exports, src/FluxMPI.jl:88-96):
 - gradients: :class:`DistributedOptimizer`, :func:`allreduce_gradients`
 - data: :class:`DistributedDataContainer`
 - config: :mod:`fluxmpi_tpu.config` (preferences)
+- telemetry: :mod:`fluxmpi_tpu.telemetry` (metrics registry, sinks,
+  :class:`~fluxmpi_tpu.telemetry.TrainingMonitor` — no reference
+  analogue; see docs/observability.md)
 """
 
 from . import config  # noqa: F401
+from . import telemetry  # noqa: F401
 from .errors import FluxMPINotInitializedError  # noqa: F401
 from .runtime import (  # noqa: F401
     Initialized,
@@ -43,6 +47,7 @@ from .comm import (  # noqa: F401
     bcast,
     cpu,
     device,
+    host_allgather,
     host_allreduce,
     host_bcast,
     iallreduce,

@@ -28,6 +28,7 @@ reference's CPU-staging fallback, src/mpi_extensions.jl:97-106).
 from __future__ import annotations
 
 import functools
+import time
 import warnings
 from typing import Any, Callable
 
@@ -39,6 +40,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import config
 from .runtime import global_mesh
+from .telemetry import get_registry as _telemetry_registry
 
 try:  # jax >= 0.4.35 exposes shard_map at top level
     shard_map = jax.shard_map
@@ -57,6 +59,7 @@ __all__ = [
     "shard_ranks",
     "unshard_ranks",
     "host_allreduce",
+    "host_allgather",
     "host_bcast",
     "Request",
 ]
@@ -214,6 +217,29 @@ def _host_collective(
     return shard_ranks(out, mesh, axis_name)
 
 
+# ---------------------------------------------------------------------------
+# Instrumentation: every eager collective records per-op call count, payload
+# bytes, and host-blocking seconds into the default telemetry registry.
+# "Host-blocking" is the wall time the *host* spends inside the call — for
+# the device path that is staging + async dispatch (the device work itself
+# overlaps; block on the result to time it), for the host-staging path it
+# includes the full device_get/reduce/device_put round trip. Cost when no
+# sink is attached: three dict hits and a few float ops per call.
+# ---------------------------------------------------------------------------
+
+
+def _record_op(op_name: str, path: str, nbytes: int, t0: float) -> None:
+    try:
+        reg = _telemetry_registry()
+        reg.counter("comm.calls", op=op_name, path=path).inc()
+        reg.counter("comm.bytes", op=op_name, path=path).inc(float(nbytes))
+        reg.histogram("comm.block_seconds", op=op_name, path=path).observe(
+            time.perf_counter() - t0
+        )
+    except Exception:  # instrumentation must never take down a collective
+        pass
+
+
 def _run_collective(
     x: Any,
     kind: str,
@@ -223,6 +249,7 @@ def _run_collective(
     axis_name: str | None = None,
     donate: bool = False,
 ) -> jax.Array:
+    t0 = time.perf_counter()
     mesh = mesh or global_mesh()
     name, size = _axis_and_size(mesh, axis_name)
     if not 0 <= root < size:
@@ -244,7 +271,9 @@ def _run_collective(
                 f"per-worker value must have leading axis == world size "
                 f"{size}, got shape {xs.shape}"
             )
-        return _host_collective(xs, kind, op, root, mesh, name)
+        out = _host_collective(xs, kind, op, root, mesh, name)
+        _record_op(kind, "host", xs.nbytes, t0)
+        return out
     xs = shard_ranks(x, mesh, name)
     # Host (non-jax.Array) inputs are staged into a buffer that is provably
     # ours alone — donate it so the collective writes in place instead of
@@ -269,7 +298,10 @@ def _run_collective(
             stacklevel=3,
         )
     fn = _collective_fn(mesh, name, kind, op, root, donate or fresh)
-    return fn(xs)
+    nbytes = xs.nbytes
+    out = fn(xs)
+    _record_op(kind, "device", nbytes, t0)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -401,12 +433,14 @@ def barrier(tag: str = "fluxmpi_barrier") -> None:
     Analogue of ``MPI.Barrier`` (reference: src/common.jl:91). Multi-host:
     a global device sync; single-process: drain local async dispatch.
     """
+    t0 = time.perf_counter()
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices(tag)
     else:
         jax.effects_barrier()
+    _record_op("barrier", "host", 0, t0)
 
 
 # ---------------------------------------------------------------------------
@@ -419,20 +453,52 @@ def barrier(tag: str = "fluxmpi_barrier") -> None:
 def host_allreduce(x: Any, op: str = "sum") -> np.ndarray:
     """Reduce a per-process host value across all controller processes."""
     op = _canonical_op(op)
+    t0 = time.perf_counter()
     h = np.asarray(x)
     if jax.process_count() == 1:
+        _record_op("host_allreduce", "host", h.nbytes, t0)
         return h
-    from jax.experimental import multihost_utils
+    from jax.experimental import multihost_utils  # pragma: no cover
 
     gathered = multihost_utils.process_allgather(h, tiled=False)
-    return np.asarray(_tree_reduce_stacked(op, jnp.asarray(gathered), axis=0))
+    out = np.asarray(_tree_reduce_stacked(op, jnp.asarray(gathered), axis=0))
+    _record_op("host_allreduce", "host", h.nbytes, t0)
+    return out
+
+
+def host_allgather(x: Any) -> np.ndarray:
+    """Gather a per-process host value from every controller process:
+    returns an array with a leading ``process_count()`` axis (this
+    process's value at its own index). One collective yields the whole
+    per-host picture — min/max/mean/outliers are then local math, which
+    is why the :class:`~fluxmpi_tpu.telemetry.TrainingMonitor` uses this
+    instead of one :func:`host_allreduce` per statistic."""
+    t0 = time.perf_counter()
+    h = np.asarray(x)
+    if jax.process_count() == 1:
+        out = h[None]
+        _record_op("host_allgather", "host", h.nbytes, t0)
+        return out
+    from jax.experimental import multihost_utils  # pragma: no cover
+
+    out = np.asarray(multihost_utils.process_allgather(h, tiled=False))
+    _record_op("host_allgather", "host", h.nbytes, t0)
+    return out
 
 
 def host_bcast(x: Any, root: int = 0) -> np.ndarray:
     """Broadcast a per-process host value from the root process to all."""
+    t0 = time.perf_counter()
     h = np.asarray(x)
     if jax.process_count() == 1:
+        _record_op("host_bcast", "host", h.nbytes, t0)
         return h
-    from jax.experimental import multihost_utils
+    from jax.experimental import multihost_utils  # pragma: no cover
 
-    return np.asarray(multihost_utils.broadcast_one_to_all(h, is_source=jax.process_index() == root))
+    out = np.asarray(
+        multihost_utils.broadcast_one_to_all(
+            h, is_source=jax.process_index() == root
+        )
+    )
+    _record_op("host_bcast", "host", h.nbytes, t0)
+    return out

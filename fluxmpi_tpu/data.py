@@ -20,6 +20,7 @@ analogue in MPI-land.
 from __future__ import annotations
 
 import math
+import time
 from typing import Any, Iterator, Sequence
 
 import numpy as np
@@ -30,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import config
 from .runtime import global_mesh
+from .telemetry import get_registry as _telemetry_registry
 
 __all__ = [
     "ArrayDataset",
@@ -194,6 +196,29 @@ class DistributedDataLoader:
         checkpoint resume (``set_epoch``) and draw independently on
         every process. Must preserve each leaf's leading (batch)
         dimension (checked).
+      transform_with_rng: explicitly declare the transform's call shape:
+        ``True`` → ``transform(batch, rng)``, ``False`` →
+        ``transform(batch)``. Default ``None`` falls back to, in order:
+        a ``transform_with_rng`` attribute on the callable itself, then
+        signature inspection — a transform whose signature has **two or
+        more REQUIRED positional parameters** (no default, not
+        keyword-only) gets the rng; ``f(batch, eps=1e-6)`` or
+        ``f(batch, *, training=False)`` does not. Un-inspectable
+        callables (C extensions, some builtins) can't be classified and
+        are assumed 1-arg with a warning — pass this parameter (or set
+        the attribute) to silence it.
+
+    Telemetry: each produced batch observes its host-side assembly +
+    transfer-initiation latency into the ``data.batch_fetch_seconds``
+    histogram, and the ``data.prefetch_depth`` gauge reads the ready
+    batches the queue held at hand-off. The queue is filled by the same
+    thread that drains it, so mid-epoch the gauge sits at ``prefetch``
+    and drops only while the source warms up / runs dry — it reports the
+    in-flight transfer window, not pipeline slack. Input-boundness is
+    the ``data.batch_fetch_seconds`` histogram against
+    ``train.step_seconds``: fetch latency rivaling step time means the
+    device is waiting on the host. Recorded into
+    :func:`fluxmpi_tpu.telemetry.get_registry`.
     """
 
     def __init__(
@@ -209,6 +234,7 @@ class DistributedDataLoader:
         drop_last: bool = True,
         prefetch: int = 2,
         transform: Any = None,
+        transform_with_rng: bool | None = None,
     ):
         if global_shuffle and not isinstance(data, DistributedDataContainer):
             raise ValueError(
@@ -251,25 +277,48 @@ class DistributedDataLoader:
         # Host-side augmentation hook — contract in the class docstring.
         self.transform = transform
         if transform is None:
+            if transform_with_rng is not None:
+                raise ValueError("transform_with_rng given without transform")
             self._transform_arity = 0
         else:
             if not callable(transform):
                 raise ValueError("transform must be callable")
-            import inspect
-
-            try:
-                params = inspect.signature(transform).parameters.values()
-                # Only REQUIRED positional params decide the call shape:
-                # f(batch, eps=1e-6) or f(batch, *, training=False) is a
-                # 1-arg transform, not a request for the rng.
-                required = sum(
-                    1 for p in params
-                    if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
-                    and p.default is p.empty
+            # Explicit declaration wins: parameter, then an attribute flag
+            # on the callable itself (lets a library transform declare its
+            # own shape); signature inspection is only the fallback.
+            if transform_with_rng is None:
+                transform_with_rng = getattr(
+                    transform, "transform_with_rng", None
                 )
-            except (TypeError, ValueError):  # builtins, C callables
-                required = 1
-            self._transform_arity = 2 if required >= 2 else 1
+            if transform_with_rng is not None:
+                self._transform_arity = 2 if transform_with_rng else 1
+            else:
+                import inspect
+
+                try:
+                    params = inspect.signature(transform).parameters.values()
+                    # Only REQUIRED positional params decide the call
+                    # shape: f(batch, eps=1e-6) or f(batch, *,
+                    # training=False) is a 1-arg transform, not a request
+                    # for the rng.
+                    required = sum(
+                        1 for p in params
+                        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+                        and p.default is p.empty
+                    )
+                except (TypeError, ValueError):  # builtins, C callables
+                    import warnings
+
+                    warnings.warn(
+                        "transform signature is not inspectable; assuming "
+                        "transform(batch) without an rng. Pass "
+                        "transform_with_rng= (or set a transform_with_rng "
+                        "attribute on the callable) to declare its call "
+                        "shape explicitly.",
+                        stacklevel=2,
+                    )
+                    required = 1
+                self._transform_arity = 2 if required >= 2 else 1
         self._epoch = 0
         # Per-process shard sizes can differ (ceil partition, remainder on
         # the last rank). jax.make_array_from_process_local_data is a
@@ -330,9 +379,26 @@ class DistributedDataLoader:
             return self.data.data.arrays, self.data.idxs.start
         return None
 
-    def __iter__(self) -> Iterator[Any]:
+    def _timed_batches(self) -> Iterator[Any]:
+        """The batch source with per-batch fetch latency observed into the
+        telemetry registry (host assembly + transform + the transfer
+        initiation inside ``make_array_from_process_local_data``)."""
         it = self._iter_batches()
+        hist = _telemetry_registry().histogram("data.batch_fetch_seconds")
+        while True:
+            t0 = time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            hist.observe(time.perf_counter() - t0)
+            yield batch
+
+    def __iter__(self) -> Iterator[Any]:
+        it = self._timed_batches()
+        depth = _telemetry_registry().gauge("data.prefetch_depth")
         if not self.prefetch:
+            depth.set(0)
             yield from it
             return
         # Device-side prefetch (flax prefetch_to_device shape, mesh-sharded):
@@ -346,8 +412,10 @@ class DistributedDataLoader:
         for batch in it:
             queue.append(batch)
             if len(queue) > self.prefetch:
+                depth.set(len(queue) - 1)
                 yield queue.popleft()
         while queue:
+            depth.set(len(queue) - 1)
             yield queue.popleft()
 
     def _iter_batches(self) -> Iterator[Any]:

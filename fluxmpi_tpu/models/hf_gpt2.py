@@ -52,10 +52,18 @@ def lm_from_gpt2(hf_model) -> tuple[TransformerLM, dict]:
     """Convert a ``transformers.GPT2LMHeadModel`` to
     ``(TransformerLM, {"params": ...})``.
 
-    The returned model is the float32 training configuration
-    (``dropout=0`` — HF's dropout only matters in torch train mode);
-    clone with ``dtype=jnp.bfloat16`` / an ``attention_fn`` for TPU
-    training, or feed it straight to ``generate``/``beam_search``.
+    The returned model is the float32 training configuration with the
+    checkpoint's ``resid_pdrop`` carried into the ``TransformerLM``
+    dropout field (0.1 on stock pretrained GPT-2 — fine-tuning an import
+    regularizes the way the torch model would, instead of silently
+    dropping dropout). ``TransformerLM`` has a single dropout rate, so a
+    config whose ``embd_pdrop``/``attn_pdrop`` differ from
+    ``resid_pdrop`` converts with a loud ``UserWarning`` naming the
+    rates it cannot represent. Training with a nonzero rate needs the
+    usual flax dropout rng (``model.apply(..., train=True,
+    rngs={"dropout": key})``); inference/`train=False` paths are
+    unaffected. Clone with ``dtype=jnp.bfloat16`` / an ``attention_fn``
+    for TPU training, or feed it straight to ``generate``/``beam_search``.
 
     Raises ``ValueError`` if the converted tree's structure or shapes
     disagree with the architecture's own init — the drift guard.
@@ -94,6 +102,24 @@ def lm_from_gpt2(hf_model) -> tuple[TransformerLM, dict]:
         raise ValueError(f"n_embd {d} not divisible by n_head {heads}")
     hd = d // heads
     d_ff = int(cfg.n_inner) if cfg.n_inner else 4 * d
+    # One dropout field here vs three pdrops there: carry resid_pdrop
+    # (the rate applied most often in the GPT-2 block) and refuse to be
+    # silent about the ones a single rate cannot represent.
+    dropout = float(getattr(cfg, "resid_pdrop", 0.0) or 0.0)
+    mismatched = {
+        knob: float(rate)
+        for knob in ("embd_pdrop", "attn_pdrop")
+        if (rate := float(getattr(cfg, knob, 0.0) or 0.0)) != dropout
+    }
+    if mismatched:
+        import warnings
+
+        warnings.warn(
+            f"TransformerLM has a single dropout rate; using "
+            f"resid_pdrop={dropout} and ignoring "
+            + ", ".join(f"{k}={v}" for k, v in sorted(mismatched.items())),
+            stacklevel=2,
+        )
     model = TransformerLM(
         vocab_size=int(cfg.vocab_size),
         max_len=int(cfg.n_positions),
@@ -101,7 +127,7 @@ def lm_from_gpt2(hf_model) -> tuple[TransformerLM, dict]:
         d_model=d,
         num_heads=heads,
         d_ff=d_ff,
-        dropout=0.0,
+        dropout=dropout,
         dtype=jnp.float32,
         ln_eps=float(cfg.layer_norm_epsilon),
     )

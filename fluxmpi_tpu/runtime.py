@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import os
 import warnings
-from typing import Sequence
+from typing import Any, Sequence
 
 import numpy as np
 
@@ -89,6 +89,7 @@ def init(
     num_processes: int | None = None,
     process_id: int | None = None,
     verbose: bool = False,
+    telemetry: Any = None,
 ) -> Mesh:
     """Bring up the fluxmpi_tpu runtime. Idempotent.
 
@@ -112,13 +113,22 @@ def init(
         ``jax.distributed.initialize`` when joining explicitly.
       verbose: print world info from every rank (reference ``verbose`` kwarg,
         src/common.jl:16).
+      telemetry: wire metric emission at bring-up — a JSONL path,
+        ``"console"``, a :class:`~fluxmpi_tpu.telemetry.Sink`, or a
+        :class:`~fluxmpi_tpu.telemetry.MetricsRegistry` to install as the
+        default (see :func:`fluxmpi_tpu.telemetry.configure`). ``None``
+        defers to the ``FLUXMPI_TPU_TELEMETRY`` env var (no-op when
+        unset). Applied even on already-initialized (idempotent) calls so
+        a notebook can attach a sink late.
 
     Returns:
       The global :class:`jax.sharding.Mesh`.
     """
     from .logging import fluxmpi_println  # local import: avoid cycle
+    from .telemetry import configure as _configure_telemetry
 
     if _state.initialized:
+        _configure_telemetry(telemetry)
         if verbose:
             fluxmpi_println("fluxmpi_tpu already initialized; skipping...")
         assert _state.mesh is not None
@@ -168,6 +178,7 @@ def init(
     mesh = Mesh(np.asarray(devs).reshape(sizes), axis_names)
     _state.mesh = mesh
     _state.initialized = True
+    _configure_telemetry(telemetry)
 
     if verbose:
         if total_workers() == 1:
@@ -195,7 +206,14 @@ Initialized = is_initialized
 
 def shutdown() -> None:
     """Reset runtime state (test helper; analogue of ``MPI.Finalize`` in the
-    reference test files, e.g. test/test_common.jl:15)."""
+    reference test files, e.g. test/test_common.jl:15). Flushes and
+    detaches any telemetry sinks so a final partial record is never lost."""
+    try:
+        from .telemetry import shutdown as _telemetry_shutdown
+
+        _telemetry_shutdown()
+    except Exception:
+        pass
     _state.initialized = False
     _state.mesh = None
 

@@ -1,0 +1,125 @@
+"""Telemetry: metrics registry, sinks, schema, and the training monitor.
+
+The observability layer the reference never had (SURVEY.md §5: its only
+timing is ad-hoc wall-clock deltas in example scripts). Three pieces:
+
+- :class:`MetricsRegistry` — labeled counter/gauge/histogram instruments
+  with explicit :meth:`~MetricsRegistry.flush` to pluggable sinks
+  (:class:`JSONLSink` / :class:`MemorySink` / :class:`ConsoleSink`);
+- built-in instrumentation recording into the *default* registry:
+  eager collectives (``comm.*``), the data loader (``data.*``), the
+  train-step ``metrics=`` hook (``train.*``), and ``bench.py``;
+- :class:`TrainingMonitor` — periodic device-memory snapshots,
+  cross-host step-time aggregation (straggler flag), and a per-host
+  heartbeat.
+
+Recording is always on (instrument updates are a few dict ops);
+*emission* is opt-in: attach a sink via :func:`configure`,
+``fluxmpi_tpu.init(telemetry=...)``, or the ``FLUXMPI_TPU_TELEMETRY``
+env var. See docs/observability.md for the JSONL schema and recipes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from .registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    set_registry,
+)
+from .schema import (  # noqa: F401
+    SCHEMA,
+    validate_bench_record,
+    validate_metric,
+    validate_record,
+)
+from .sinks import (  # noqa: F401
+    ConsoleSink,
+    JSONLSink,
+    MemorySink,
+    NullSink,
+    Sink,
+)
+from .monitor import TrainingMonitor  # noqa: F401
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "get_registry",
+    "set_registry",
+    "SCHEMA",
+    "validate_record",
+    "validate_metric",
+    "validate_bench_record",
+    "Sink",
+    "JSONLSink",
+    "MemorySink",
+    "ConsoleSink",
+    "NullSink",
+    "TrainingMonitor",
+    "configure",
+    "shutdown",
+]
+
+_ENV_VAR = "FLUXMPI_TPU_TELEMETRY"
+
+
+def configure(spec: Any = None) -> MetricsRegistry:
+    """Wire emission for the default registry from a one-value spec.
+
+    ``spec`` may be:
+
+    - ``None`` — read the ``FLUXMPI_TPU_TELEMETRY`` env var (same forms
+      below; no-op when unset);
+    - ``"console"`` / ``True`` — attach a rank-0 :class:`ConsoleSink`;
+    - any other string — treat as a path, attach a :class:`JSONLSink`;
+    - a :class:`Sink` instance — attach it;
+    - a :class:`MetricsRegistry` — install it as the default registry.
+
+    Returns the (possibly new) default registry. Called by
+    ``fluxmpi_tpu.init(telemetry=...)``; safe to call directly.
+    Idempotent for equivalent specs — ``init()`` is idempotent, so a
+    repeated bring-up must not attach the same sink twice.
+    """
+    if spec is None:
+        spec = os.environ.get(_ENV_VAR) or None
+        if spec is None:
+            return get_registry()
+    if isinstance(spec, MetricsRegistry):
+        set_registry(spec)
+        return spec
+    reg = get_registry()
+    if spec is True or spec == "console":
+        if any(isinstance(s, ConsoleSink) for s in reg.sinks):
+            return reg
+        sink: Sink = ConsoleSink()
+    elif isinstance(spec, Sink):
+        if spec in reg.sinks:
+            return reg
+        sink = spec
+    elif isinstance(spec, str):
+        if any(
+            isinstance(s, JSONLSink) and s.path == spec for s in reg.sinks
+        ):
+            return reg
+        sink = JSONLSink(spec)
+    else:
+        raise ValueError(
+            f"telemetry spec must be a path, 'console', a Sink, or a "
+            f"MetricsRegistry; got {spec!r}"
+        )
+    reg.add_sink(sink)
+    return reg
+
+
+def shutdown() -> None:
+    """Flush and detach every sink on the default registry (instruments
+    survive — a re-configured registry keeps its cumulative counters)."""
+    get_registry().close()

@@ -1,0 +1,160 @@
+"""Cross-host training monitor: device memory, stragglers, heartbeat.
+
+The reference has nothing like this (its examples eyeball wall-clock
+deltas per rank); at pod scale the two questions that matter are "is a
+host slow?" and "is a host *gone*?", and they need different signals:
+
+- **straggler**: every host still participates in collectives, one of
+  them late. Detected by aggregating per-host mean step time across
+  processes (one :func:`fluxmpi_tpu.comm.host_allgather` of the scalar,
+  min/max/mean locally) and flagging ``max > threshold * mean``.
+- **hung rank**: a host stopped participating entirely. A hung rank
+  cannot be seen *through* a collective (the collective itself blocks),
+  so detection is push-based: every host stamps a heartbeat gauge into
+  its own flush stream each collect. A reader (or a human tailing the
+  per-process JSONL files) distinguishes the cases by the stream itself:
+  stale stream = hung; fresh stream with fat ``monitor.step_seconds_max``
+  = slow.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import numpy as np
+
+from .registry import MetricsRegistry, get_registry
+
+__all__ = ["TrainingMonitor"]
+
+
+class TrainingMonitor:
+    """Periodic collector of device memory stats and cross-host step-time
+    aggregates, flushing the registry every ``interval`` observed steps.
+
+    Usage — either hand it to the train-step factory::
+
+        mon = TrainingMonitor(interval=20)
+        step = make_train_step(loss_fn, opt, metrics=mon)
+
+    or drive it manually: ``mon.observe_step(seconds)`` per step, or call
+    :meth:`collect` on your own schedule.
+
+    Args:
+      registry: registry to record into (default: the global one, so the
+        comm/data instrumentation lands in the same flush lines).
+      interval: observed steps between automatic :meth:`collect` calls.
+      cross_host: aggregate step times across controller processes. Every
+        participating process must call :meth:`collect` the same number
+        of times (it is a host collective) — the step-count cadence
+        guarantees that in SPMD loops. Set False for loops where hosts
+        can diverge.
+      straggler_threshold: flag when the slowest host's mean step time
+        exceeds this multiple of the cross-host mean.
+    """
+
+    def __init__(
+        self,
+        registry: MetricsRegistry | None = None,
+        *,
+        interval: int = 50,
+        cross_host: bool = True,
+        straggler_threshold: float = 1.5,
+    ):
+        if interval < 1:
+            raise ValueError(f"interval must be >= 1, got {interval}")
+        self.registry = registry if registry is not None else get_registry()
+        self.interval = interval
+        self.cross_host = cross_host
+        self.straggler_threshold = straggler_threshold
+        self._window: list[float] = []
+        self._since_collect = 0
+
+    def observe_step(self, seconds: float) -> dict[str, Any] | None:
+        """Record one step's duration; every ``interval`` steps, collect
+        and flush. Returns the collect summary on collecting ticks."""
+        self._window.append(float(seconds))
+        self._since_collect += 1
+        if self._since_collect >= self.interval:
+            return self.collect()
+        return None
+
+    # -- collection ----------------------------------------------------
+
+    def _collect_memory(self) -> None:
+        import jax
+
+        for i, d in enumerate(jax.local_devices()):
+            try:
+                stats = d.memory_stats() or {}
+            except Exception:  # backends without memory stats
+                stats = {}
+            for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+                if key in stats:
+                    self.registry.gauge(
+                        f"device.memory.{key}", device=str(i)
+                    ).set(float(stats[key]))
+        # CPU (and some backends) report no per-device stats — the host
+        # peak RSS keeps a memory signal in every stream regardless.
+        try:
+            import resource
+            import sys
+
+            rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            # ru_maxrss unit: bytes on darwin, kilobytes elsewhere.
+            scale = 1.0 if sys.platform == "darwin" else 1024.0
+            self.registry.gauge("host.memory.peak_rss_bytes").set(
+                float(rss) * scale
+            )
+        except Exception:  # pragma: no cover - non-POSIX
+            pass
+
+    def _aggregate_step_times(self) -> dict[str, float]:
+        local_mean = sum(self._window) / len(self._window)
+        import jax
+
+        nproc = jax.process_count()
+        if self.cross_host and nproc > 1:  # pragma: no cover - multihost only
+            # ONE gather of the scalar, statistics locally — three
+            # per-statistic host_allreduce calls would triple the
+            # blocking collective cost paid every interval.
+            from ..comm import host_allgather
+
+            means = host_allgather(np.float32(local_mean))
+            mn = float(means.min())
+            mx = float(means.max())
+            mean = float(means.mean())
+        else:
+            mn = mx = mean = local_mean
+        straggler = mean > 0 and mx > self.straggler_threshold * mean
+        reg = self.registry
+        reg.gauge("monitor.step_seconds_local_mean").set(local_mean)
+        reg.gauge("monitor.step_seconds_min").set(mn)
+        reg.gauge("monitor.step_seconds_max").set(mx)
+        reg.gauge("monitor.step_seconds_mean").set(mean)
+        reg.gauge("monitor.straggler").set(float(straggler))
+        return {
+            "step_seconds_local_mean": local_mean,
+            "step_seconds_min": mn,
+            "step_seconds_max": mx,
+            "step_seconds_mean": mean,
+            "straggler": straggler,
+        }
+
+    def collect(self) -> dict[str, Any]:
+        """Snapshot device memory, aggregate step times across hosts,
+        stamp the heartbeat, and flush the registry (one JSONL line on a
+        file-sinked registry). Returns a plain-python summary."""
+        summary: dict[str, Any] = {}
+        self._collect_memory()
+        if self._window:
+            summary = self._aggregate_step_times()
+            self._window = []
+        self._since_collect = 0
+        # Heartbeat: this host is alive and flushing. The *absence* of
+        # fresh heartbeats in a host's stream is the hung-rank signal.
+        self.registry.counter("monitor.heartbeat").inc()
+        self.registry.gauge("monitor.heartbeat_unix").set(time.time())
+        summary["record"] = self.registry.flush()
+        return summary

@@ -1,0 +1,143 @@
+"""Telemetry record schemas and validators.
+
+The single source of truth for what a telemetry JSONL line and a bench
+output record look like. `scripts/check_metrics_schema.py` loads this
+module by file path (no package import, no jax) so schema drift in
+either producer is caught at PR time without booting a backend —
+deliberately stdlib-only: importing it must never pull in jax.
+
+Telemetry flush record (one JSON object per line in a JSONL stream):
+
+    {
+      "schema": "fluxmpi_tpu.telemetry/v1",
+      "time_unix": 1753812345.123,       # host wall clock at flush
+      "process": 0,                       # controller process index
+      "metrics": [ <metric>, ... ],
+      ...optional extra keys (e.g. "bench" for bench emissions)
+    }
+
+Metric objects share ``name`` (dotted, e.g. "comm.bytes"), ``type``
+("counter" | "gauge" | "histogram"), and ``labels`` (flat str->str):
+
+    counter:   {"value": <number>}            # cumulative, monotonic
+    gauge:     {"value": <number>}            # last set value
+    histogram: {"count": <int>, "sum": <number>,
+                "min"/"max"/"mean"/"last": <number>}   # when count > 0
+
+Bench record (``bench.py`` stdout JSON line / BENCH_*.json "tail"):
+required keys ``metric`` (str), ``value`` (number), ``unit`` (str),
+``vs_baseline`` (number); known optional keys are type-checked, unknown
+keys are allowed (forward compatibility).
+"""
+
+from __future__ import annotations
+
+SCHEMA = "fluxmpi_tpu.telemetry/v1"
+
+METRIC_TYPES = ("counter", "gauge", "histogram")
+
+_HIST_STAT_KEYS = ("sum", "min", "max", "mean", "last")
+
+# Known optional bench keys -> required type(s). Unknown keys pass (new
+# fields must not break old validators); known keys with the wrong type
+# fail (that is the drift being guarded against).
+_BENCH_OPTIONAL: dict[str, tuple[type, ...]] = {
+    "platform": (str,),
+    "device_kind": (str,),
+    "n_chips": (int,),
+    "mfu": (int, float),
+    "flops_source": (str,),
+    "scan_steps": (int,),
+    "probe": (dict,),
+    "scaling": (dict,),
+    "attention": (dict,),
+    "transformer_lm": (dict,),
+    "deq": (dict,),
+}
+
+
+def _is_number(x: object) -> bool:
+    return isinstance(x, (int, float)) and not isinstance(x, bool)
+
+
+def validate_metric(m: object, where: str = "metric") -> list[str]:
+    """Validate one metric object; returns a list of error strings."""
+    errors: list[str] = []
+    if not isinstance(m, dict):
+        return [f"{where}: not an object: {m!r}"]
+    name = m.get("name")
+    if not isinstance(name, str) or not name:
+        errors.append(f"{where}: missing/invalid 'name': {name!r}")
+        name = "<unnamed>"
+    where = f"{where} {name!r}"
+    kind = m.get("type")
+    if kind not in METRIC_TYPES:
+        errors.append(f"{where}: 'type' must be one of {METRIC_TYPES}, got {kind!r}")
+        return errors
+    labels = m.get("labels", {})
+    if not isinstance(labels, dict) or not all(
+        isinstance(k, str) and isinstance(v, str) for k, v in labels.items()
+    ):
+        errors.append(f"{where}: 'labels' must map str -> str, got {labels!r}")
+    if kind in ("counter", "gauge"):
+        if not _is_number(m.get("value")):
+            errors.append(f"{where}: missing numeric 'value'")
+    else:  # histogram
+        count = m.get("count")
+        if not isinstance(count, int) or isinstance(count, bool) or count < 0:
+            errors.append(f"{where}: histogram 'count' must be an int >= 0")
+        elif count > 0:
+            for k in _HIST_STAT_KEYS:
+                if not _is_number(m.get(k)):
+                    errors.append(f"{where}: histogram missing numeric {k!r}")
+    return errors
+
+
+def validate_record(rec: object) -> list[str]:
+    """Validate one telemetry flush record; returns a list of error strings
+    (empty == valid)."""
+    if not isinstance(rec, dict):
+        return [f"record is not an object: {type(rec).__name__}"]
+    errors: list[str] = []
+    if rec.get("schema") != SCHEMA:
+        errors.append(
+            f"'schema' must be {SCHEMA!r}, got {rec.get('schema')!r}"
+        )
+    if not _is_number(rec.get("time_unix")):
+        errors.append("missing numeric 'time_unix'")
+    proc = rec.get("process")
+    if not isinstance(proc, int) or isinstance(proc, bool) or proc < 0:
+        errors.append("'process' must be an int >= 0")
+    metrics = rec.get("metrics")
+    if not isinstance(metrics, list):
+        errors.append("'metrics' must be a list")
+    else:
+        for i, m in enumerate(metrics):
+            errors.extend(validate_metric(m, where=f"metrics[{i}]"))
+    return errors
+
+
+def validate_bench_record(rec: object) -> list[str]:
+    """Validate a bench.py output record (the headline JSON line)."""
+    if not isinstance(rec, dict):
+        return [f"bench record is not an object: {type(rec).__name__}"]
+    errors: list[str] = []
+    if not isinstance(rec.get("metric"), str) or not rec.get("metric"):
+        errors.append("missing/invalid 'metric' (str)")
+    if not _is_number(rec.get("value")):
+        errors.append("missing numeric 'value'")
+    if not isinstance(rec.get("unit"), str):
+        errors.append("missing/invalid 'unit' (str)")
+    if not _is_number(rec.get("vs_baseline")):
+        errors.append("missing numeric 'vs_baseline'")
+    for key, types in _BENCH_OPTIONAL.items():
+        if key in rec and not (
+            isinstance(rec[key], types) and not isinstance(rec[key], bool)
+        ):
+            errors.append(
+                f"{key!r} must be {'/'.join(t.__name__ for t in types)}, "
+                f"got {type(rec[key]).__name__}"
+            )
+    if "mfu" in rec and _is_number(rec["mfu"]) and not 0 <= rec["mfu"] <= 1:
+        errors.append(f"'mfu' out of range [0, 1]: {rec['mfu']!r}")
+    return errors

@@ -44,7 +44,13 @@ class _TimerHandle:
 
 
 @contextlib.contextmanager
-def step_timer(result_holder: dict, key: str = "seconds") -> Iterator[_TimerHandle]:
+def step_timer(
+    result_holder: dict,
+    key: str = "seconds",
+    *,
+    metric: str | None = None,
+    registry: Any | None = None,
+) -> Iterator[_TimerHandle]:
     """Time the enclosed block including async-dispatched device work.
 
     Register the block's outputs with ``handle.watch(out)`` so the timer
@@ -52,6 +58,11 @@ def step_timer(result_holder: dict, key: str = "seconds") -> Iterator[_TimerHand
     timing). With nothing watched, a sentinel computation is enqueued per
     local device and blocked on — TPU executes programs in order per
     device, so this drains prior dispatched work.
+
+    ``metric="train.step_seconds"`` additionally observes the elapsed
+    time into a telemetry histogram of that name (on ``registry``, or the
+    default :func:`fluxmpi_tpu.telemetry.get_registry` when omitted) —
+    the bridge between this timing discipline and the metrics substrate.
     """
     handle = _TimerHandle()
     t0 = time.perf_counter()
@@ -64,7 +75,14 @@ def step_timer(result_holder: dict, key: str = "seconds") -> Iterator[_TimerHand
         bump = jax.jit(lambda x: x + 1)
         for d in jax.local_devices():
             bump(jax.device_put(jnp.zeros(()), d)).block_until_ready()
-    result_holder[key] = time.perf_counter() - t0
+    elapsed = time.perf_counter() - t0
+    result_holder[key] = elapsed
+    if metric is not None:
+        if registry is None:
+            from ..telemetry import get_registry
+
+            registry = get_registry()
+        registry.histogram(metric).observe(elapsed)
 
 
 def block_on(tree: Any) -> Any:

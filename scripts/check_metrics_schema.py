@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Validate bench JSON and telemetry JSONL files against the documented
+schema (fluxmpi_tpu/telemetry/schema.py — the single source of truth).
+
+Usage:
+    python scripts/check_metrics_schema.py [FILE ...]
+
+- ``*.jsonl`` files: every line must be a valid telemetry flush record
+  (schema "fluxmpi_tpu.telemetry/v1"); a line carrying a ``bench`` key
+  must also embed a valid bench record.
+- ``*.json`` files: a bench record — either bench.py's raw output
+  (``{"metric": ...}``) or a driver BENCH_*.json wrapper whose ``tail``
+  holds the JSON line bench.py printed.
+
+With no arguments, validates every ``BENCH_*.json`` in the repo root —
+the PR-time drift check (wired into tests/test_telemetry.py).
+
+The schema module is loaded by file path, NOT via ``import fluxmpi_tpu``:
+this script must stay runnable in a second without booting jax or any
+backend.
+"""
+
+from __future__ import annotations
+
+import glob
+import importlib.util
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_schema():
+    path = os.path.join(_REPO, "fluxmpi_tpu", "telemetry", "schema.py")
+    spec = importlib.util.spec_from_file_location("_fluxmpi_schema", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _bench_record_from(data: dict) -> dict | None:
+    """Extract the bench record from either bench.py's raw output or a
+    driver BENCH_*.json wrapper (record rides as the last JSON line of
+    the captured ``tail``). Returns None when the wrapper holds no record
+    (e.g. a round where bench.py never ran)."""
+    if "metric" in data:
+        return data
+    tail = data.get("tail")
+    if isinstance(tail, str):
+        for line in reversed(tail.strip().splitlines()):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict) and "metric" in rec:
+                return rec
+    return None
+
+
+def check_file(path: str, schema) -> list[str]:
+    """Validate one file; returns error strings prefixed with location."""
+    errors: list[str] = []
+    with open(path, "r", encoding="utf-8") as f:
+        content = f.read()
+    if path.endswith(".jsonl"):
+        for i, line in enumerate(content.splitlines(), 1):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as exc:
+                errors.append(f"{path}:{i}: not JSON: {exc}")
+                continue
+            for e in schema.validate_record(rec):
+                errors.append(f"{path}:{i}: {e}")
+            if isinstance(rec, dict) and "bench" in rec:
+                for e in schema.validate_bench_record(rec["bench"]):
+                    errors.append(f"{path}:{i}: bench: {e}")
+        return errors
+    try:
+        data = json.loads(content)
+    except json.JSONDecodeError as exc:
+        return [f"{path}: not JSON: {exc}"]
+    rec = _bench_record_from(data) if isinstance(data, dict) else None
+    if rec is None:
+        # A wrapper with no bench line is a bench that never ran — not a
+        # schema violation; drift in records that DO exist is the target.
+        return errors
+    for e in schema.validate_bench_record(rec):
+        errors.append(f"{path}: {e}")
+    return errors
+
+
+def main(argv: list[str]) -> int:
+    schema = _load_schema()
+    paths = argv or sorted(glob.glob(os.path.join(_REPO, "BENCH_*.json")))
+    if not paths:
+        print("check_metrics_schema: nothing to validate", file=sys.stderr)
+        return 0
+    errors: list[str] = []
+    for path in paths:
+        if not os.path.exists(path):
+            errors.append(f"{path}: no such file")
+            continue
+        errors.extend(check_file(path, schema))
+    for e in errors:
+        print(e, file=sys.stderr)
+    print(
+        f"check_metrics_schema: {len(paths)} file(s), "
+        f"{len(errors)} error(s)"
+    )
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
