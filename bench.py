@@ -47,6 +47,14 @@ Env knobs:
   FLUXMPI_TPU_BENCH_JSONL     also emit results through the telemetry
                               JSONL sink at this path (schema-validated
                               by scripts/check_metrics_schema.py)
+  FLUXMPI_TPU_BENCH_TRACE_DIR enable span tracing in each bench child and
+                              export a Chrome-trace JSON per config into
+                              this directory (trace.<config>.json —
+                              merge with scripts/merge_traces.py).
+                              FLUXMPI_TPU_TRACE / FLUXMPI_TPU_WATCHDOG
+                              themselves also pass through to children
+                              (the overhead-budget check runs the mlp
+                              config with both enabled).
 """
 
 from __future__ import annotations
@@ -952,6 +960,23 @@ def _run_child(
 ) -> dict | None:
     """Run one bench config in a child process; parse its final JSON line.
     Returns None on timeout/crash/garbage so the caller can fall back."""
+    trace_dir = os.environ.get("FLUXMPI_TPU_BENCH_TRACE_DIR")
+    if trace_dir:
+        os.makedirs(trace_dir, exist_ok=True)
+        # The same config can run as multiple children (headline run +
+        # the dp1/dpN scaling pair): discriminate the filename by the
+        # device count so the scaling comparison's traces both survive.
+        devs = (extra_env or {}).get(
+            "FLUXMPI_TPU_BENCH_DEVICES",
+            os.environ.get("FLUXMPI_TPU_BENCH_DEVICES", ""),
+        )
+        tag = f"{config}.dp{devs}" if devs else config
+        extra_env = {
+            **(extra_env or {}),
+            "FLUXMPI_TPU_TRACE": os.path.join(
+                trace_dir, f"trace.{tag}.json"
+            ),
+        }
     try:
         proc = _spawn(["--child", config], timeout, platform, extra_env)
     except subprocess.TimeoutExpired:
@@ -985,7 +1010,17 @@ def _child_main(config: str) -> None:
 
         jax.config.update("jax_platforms", platform)
     _enable_compilation_cache()
-    print(json.dumps(_CHILD_FNS[config]()), flush=True)
+    result = _CHILD_FNS[config]()
+    # Export the span ring if FLUXMPI_TPU_TRACE named a path (set by the
+    # parent's FLUXMPI_TPU_BENCH_TRACE_DIR passthrough, or directly):
+    # the workload ran under fm.init, which wired tracing from the env.
+    try:
+        from fluxmpi_tpu.telemetry import tracing as _tracing
+
+        _tracing.shutdown()
+    except Exception as exc:
+        print(f"bench: trace export failed: {exc!r}", file=sys.stderr)
+    print(json.dumps(result), flush=True)
 
 
 def _probe_timeouts() -> tuple[float, ...]:

@@ -18,7 +18,8 @@ Public surface (parity with reference exports, src/FluxMPI.jl:88-96):
 - data: :class:`DistributedDataContainer`
 - config: :mod:`fluxmpi_tpu.config` (preferences)
 - telemetry: :mod:`fluxmpi_tpu.telemetry` (metrics registry, sinks,
-  :class:`~fluxmpi_tpu.telemetry.TrainingMonitor` — no reference
+  :class:`~fluxmpi_tpu.telemetry.TrainingMonitor`, span tracing, the
+  collective flight recorder, and the hang watchdog — no reference
   analogue; see docs/observability.md)
 """
 

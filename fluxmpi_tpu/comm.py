@@ -41,6 +41,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from . import config
 from .runtime import global_mesh
 from .telemetry import get_registry as _telemetry_registry
+from .telemetry import tracing as _tracing
+from .telemetry.flight_recorder import (
+    get_flight_recorder as _flight_recorder,
+)
 
 try:  # jax >= 0.4.35 exposes shard_map at top level
     shard_map = jax.shard_map
@@ -225,16 +229,52 @@ def _host_collective(
 # overlaps; block on the result to time it), for the host-staging path it
 # includes the full device_get/reduce/device_put round trip. Cost when no
 # sink is attached: three dict hits and a few float ops per call.
+#
+# The flight recorder is the second consumer: _begin_op appends a ring
+# entry (monotonic sequence number, op, path, nbytes) BEFORE the
+# potentially-blocking call, _record_op marks it completed after — so a
+# rank hung inside a collective leaves a tail entry with completed=False
+# naming exactly which collective it is stuck in, and diffing per-host
+# dumps localizes a desync (see telemetry/flight_recorder.py). When
+# tracing is enabled the same t0/t1 pair lands on the span timeline as a
+# comm.<op> event. Both are one deque append — no locks on this path.
 # ---------------------------------------------------------------------------
 
 
-def _record_op(op_name: str, path: str, nbytes: int, t0: float) -> None:
+def _begin_op(op_name: str, path: str, nbytes: int) -> Any:
     try:
+        return _flight_recorder().begin(op_name, path, nbytes)
+    except Exception:  # instrumentation must never take down a collective
+        return None
+
+
+def _abort_op(flight: Any) -> None:
+    """Finalize a flight entry whose collective RAISED: an exception is
+    not a hang, and a permanently-incomplete entry would make every
+    later dump name a long-dead error as the in-flight collective."""
+    if flight is None:
+        return
+    try:
+        _flight_recorder().abort(flight)
+    except Exception:
+        pass
+
+
+def _record_op(
+    op_name: str, path: str, nbytes: int, t0: float, flight: Any = None
+) -> None:
+    try:
+        t1 = time.perf_counter()
+        if flight is not None:
+            _flight_recorder().complete(flight)
+        _tracing.add_complete_event(
+            "comm." + op_name, t0, t1, path=path, nbytes=int(nbytes)
+        )
         reg = _telemetry_registry()
         reg.counter("comm.calls", op=op_name, path=path).inc()
         reg.counter("comm.bytes", op=op_name, path=path).inc(float(nbytes))
         reg.histogram("comm.block_seconds", op=op_name, path=path).observe(
-            time.perf_counter() - t0
+            t1 - t0
         )
     except Exception:  # instrumentation must never take down a collective
         pass
@@ -271,8 +311,13 @@ def _run_collective(
                 f"per-worker value must have leading axis == world size "
                 f"{size}, got shape {xs.shape}"
             )
-        out = _host_collective(xs, kind, op, root, mesh, name)
-        _record_op(kind, "host", xs.nbytes, t0)
+        flight = _begin_op(kind, "host", xs.nbytes)
+        try:
+            out = _host_collective(xs, kind, op, root, mesh, name)
+        except BaseException:
+            _abort_op(flight)
+            raise
+        _record_op(kind, "host", xs.nbytes, t0, flight)
         return out
     xs = shard_ranks(x, mesh, name)
     # Host (non-jax.Array) inputs are staged into a buffer that is provably
@@ -299,8 +344,13 @@ def _run_collective(
         )
     fn = _collective_fn(mesh, name, kind, op, root, donate or fresh)
     nbytes = xs.nbytes
-    out = fn(xs)
-    _record_op(kind, "device", nbytes, t0)
+    flight = _begin_op(kind, "device", nbytes)
+    try:
+        out = fn(xs)
+    except BaseException:
+        _abort_op(flight)
+        raise
+    _record_op(kind, "device", nbytes, t0, flight)
     return out
 
 
@@ -434,13 +484,18 @@ def barrier(tag: str = "fluxmpi_barrier") -> None:
     a global device sync; single-process: drain local async dispatch.
     """
     t0 = time.perf_counter()
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+    flight = _begin_op("barrier", "host", 0)
+    try:
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices(tag)
-    else:
-        jax.effects_barrier()
-    _record_op("barrier", "host", 0, t0)
+            multihost_utils.sync_global_devices(tag)
+        else:
+            jax.effects_barrier()
+    except BaseException:
+        _abort_op(flight)
+        raise
+    _record_op("barrier", "host", 0, t0, flight)
 
 
 # ---------------------------------------------------------------------------
@@ -455,14 +510,21 @@ def host_allreduce(x: Any, op: str = "sum") -> np.ndarray:
     op = _canonical_op(op)
     t0 = time.perf_counter()
     h = np.asarray(x)
+    flight = _begin_op("host_allreduce", "host", h.nbytes)
     if jax.process_count() == 1:
-        _record_op("host_allreduce", "host", h.nbytes, t0)
+        _record_op("host_allreduce", "host", h.nbytes, t0, flight)
         return h
-    from jax.experimental import multihost_utils  # pragma: no cover
+    try:  # pragma: no cover - multihost only
+        from jax.experimental import multihost_utils
 
-    gathered = multihost_utils.process_allgather(h, tiled=False)
-    out = np.asarray(_tree_reduce_stacked(op, jnp.asarray(gathered), axis=0))
-    _record_op("host_allreduce", "host", h.nbytes, t0)
+        gathered = multihost_utils.process_allgather(h, tiled=False)
+        out = np.asarray(
+            _tree_reduce_stacked(op, jnp.asarray(gathered), axis=0)
+        )
+    except BaseException:  # pragma: no cover - multihost only
+        _abort_op(flight)
+        raise
+    _record_op("host_allreduce", "host", h.nbytes, t0, flight)
     return out
 
 
@@ -475,14 +537,19 @@ def host_allgather(x: Any) -> np.ndarray:
     instead of one :func:`host_allreduce` per statistic."""
     t0 = time.perf_counter()
     h = np.asarray(x)
+    flight = _begin_op("host_allgather", "host", h.nbytes)
     if jax.process_count() == 1:
         out = h[None]
-        _record_op("host_allgather", "host", h.nbytes, t0)
+        _record_op("host_allgather", "host", h.nbytes, t0, flight)
         return out
-    from jax.experimental import multihost_utils  # pragma: no cover
+    try:  # pragma: no cover - multihost only
+        from jax.experimental import multihost_utils
 
-    out = np.asarray(multihost_utils.process_allgather(h, tiled=False))
-    _record_op("host_allgather", "host", h.nbytes, t0)
+        out = np.asarray(multihost_utils.process_allgather(h, tiled=False))
+    except BaseException:  # pragma: no cover - multihost only
+        _abort_op(flight)
+        raise
+    _record_op("host_allgather", "host", h.nbytes, t0, flight)
     return out
 
 
@@ -490,15 +557,20 @@ def host_bcast(x: Any, root: int = 0) -> np.ndarray:
     """Broadcast a per-process host value from the root process to all."""
     t0 = time.perf_counter()
     h = np.asarray(x)
+    flight = _begin_op("host_bcast", "host", h.nbytes)
     if jax.process_count() == 1:
-        _record_op("host_bcast", "host", h.nbytes, t0)
+        _record_op("host_bcast", "host", h.nbytes, t0, flight)
         return h
-    from jax.experimental import multihost_utils  # pragma: no cover
+    try:  # pragma: no cover - multihost only
+        from jax.experimental import multihost_utils
 
-    out = np.asarray(
-        multihost_utils.broadcast_one_to_all(
-            h, is_source=jax.process_index() == root
+        out = np.asarray(
+            multihost_utils.broadcast_one_to_all(
+                h, is_source=jax.process_index() == root
+            )
         )
-    )
-    _record_op("host_bcast", "host", h.nbytes, t0)
+    except BaseException:  # pragma: no cover - multihost only
+        _abort_op(flight)
+        raise
+    _record_op("host_bcast", "host", h.nbytes, t0, flight)
     return out

@@ -32,6 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from . import config
 from .runtime import global_mesh
 from .telemetry import get_registry as _telemetry_registry
+from .telemetry import tracing as _tracing
 
 __all__ = [
     "ArrayDataset",
@@ -382,16 +383,30 @@ class DistributedDataLoader:
     def _timed_batches(self) -> Iterator[Any]:
         """The batch source with per-batch fetch latency observed into the
         telemetry registry (host assembly + transform + the transfer
-        initiation inside ``make_array_from_process_local_data``)."""
+        initiation inside ``make_array_from_process_local_data``) and,
+        when tracing is enabled, a ``data.fetch`` span per batch on the
+        same timeline as ``train.step`` — fetch spans rivaling step
+        spans is the input-bound picture, now visible in Perfetto."""
+        from .telemetry.watchdog import notify_progress
+
         it = self._iter_batches()
         hist = _telemetry_registry().histogram("data.batch_fetch_seconds")
+        b = 0
         while True:
             t0 = time.perf_counter()
             try:
                 batch = next(it)
             except StopIteration:
                 return
-            hist.observe(time.perf_counter() - t0)
+            t1 = time.perf_counter()
+            hist.observe(t1 - t0)
+            _tracing.add_complete_event("data.fetch", t0, t1, batch=b)
+            # Each produced batch is a watchdog liveness tick: the source
+            # is drained by the consuming loop itself, so a hung step
+            # stops this too — which gives every loader-fed loop a
+            # progress signal even without the metrics= hook.
+            notify_progress()
+            b += 1
             yield batch
 
     def __iter__(self) -> Iterator[Any]:

@@ -134,17 +134,27 @@ def _instrument_step(compiled, metrics: Any, scan_steps: int):
     dispatch cannot under-report. Everything else is a handful of host
     float/dict ops — cheap enough to leave on (<2% on the mlp bench with
     a no-op sink; emission cost is the sink's business, at flush time).
+
+    The step is also a trace span (``train.step`` on the
+    :mod:`~fluxmpi_tpu.telemetry.tracing` timeline when tracing is
+    enabled; one no-op call otherwise) and a watchdog progress tick —
+    an armed :class:`~fluxmpi_tpu.telemetry.Watchdog` counts completed
+    steps as liveness.
     """
     from ..telemetry import get_registry
+    from ..telemetry import tracing as _tracing
+    from ..telemetry.watchdog import notify_progress
     from ..utils.profiling import step_timer
 
     reg, monitor, hook = _resolve_metrics(metrics)
 
     def step(state, batch):
         holder: dict[str, float] = {}
-        with step_timer(holder) as t:
-            new_state, (loss, gnorm) = compiled(state, batch)
-            t.watch((loss, gnorm))
+        with _tracing.span("train.step"):
+            with step_timer(holder) as t:
+                new_state, (loss, gnorm) = compiled(state, batch)
+                t.watch((loss, gnorm))
+        notify_progress()
         seconds = holder["seconds"]
         loss_h = np.asarray(jax.device_get(loss))
         gnorm_h = np.asarray(jax.device_get(gnorm))

@@ -90,6 +90,8 @@ def init(
     process_id: int | None = None,
     verbose: bool = False,
     telemetry: Any = None,
+    trace: Any = None,
+    watchdog: Any = None,
 ) -> Mesh:
     """Bring up the fluxmpi_tpu runtime. Idempotent.
 
@@ -120,15 +122,32 @@ def init(
         defers to the ``FLUXMPI_TPU_TELEMETRY`` env var (no-op when
         unset). Applied even on already-initialized (idempotent) calls so
         a notebook can attach a sink late.
+      trace: wire span tracing at bring-up — ``True`` enables recording
+        into the bounded ring, a path additionally exports Chrome-trace
+        JSON there at :func:`shutdown` (``{process}`` in the path is
+        formatted per host); see
+        :func:`fluxmpi_tpu.telemetry.tracing.configure`. ``None`` defers
+        to ``FLUXMPI_TPU_TRACE``.
+      watchdog: arm the hang watchdog — ``True`` or a deadline in
+        seconds (stall → per-host dump of thread stacks, the collective
+        flight-recorder tail, open spans, and a final registry flush;
+        ``SIGUSR1`` dumps on demand); see
+        :func:`fluxmpi_tpu.telemetry.watchdog.configure`. ``None``
+        defers to ``FLUXMPI_TPU_WATCHDOG``. Like ``telemetry``, both are
+        applied on idempotent replays too.
 
     Returns:
       The global :class:`jax.sharding.Mesh`.
     """
     from .logging import fluxmpi_println  # local import: avoid cycle
     from .telemetry import configure as _configure_telemetry
+    from .telemetry import tracing as _tracing
+    from .telemetry import watchdog as _watchdog
 
     if _state.initialized:
         _configure_telemetry(telemetry)
+        _tracing.configure(trace)
+        _watchdog.configure(watchdog)
         if verbose:
             fluxmpi_println("fluxmpi_tpu already initialized; skipping...")
         assert _state.mesh is not None
@@ -179,6 +198,8 @@ def init(
     _state.mesh = mesh
     _state.initialized = True
     _configure_telemetry(telemetry)
+    _tracing.configure(trace)
+    _watchdog.configure(watchdog)
 
     if verbose:
         if total_workers() == 1:
@@ -206,8 +227,11 @@ Initialized = is_initialized
 
 def shutdown() -> None:
     """Reset runtime state (test helper; analogue of ``MPI.Finalize`` in the
-    reference test files, e.g. test/test_common.jl:15). Flushes and
-    detaches any telemetry sinks so a final partial record is never lost."""
+    reference test files, e.g. test/test_common.jl:15). Disarms the
+    watchdog, exports the trace ring (when a path was configured), and
+    flushes/detaches any telemetry sinks so a final partial record is
+    never lost — then drops the mesh. Ordered so the trace export still
+    sees the process index."""
     try:
         from .telemetry import shutdown as _telemetry_shutdown
 

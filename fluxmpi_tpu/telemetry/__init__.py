@@ -1,7 +1,9 @@
-"""Telemetry: metrics registry, sinks, schema, and the training monitor.
+"""Telemetry: metrics, tracing, flight recorder, watchdog, monitor.
 
 The observability layer the reference never had (SURVEY.md §5: its only
-timing is ad-hoc wall-clock deltas in example scripts). Three pieces:
+timing is ad-hoc wall-clock deltas in example scripts). Two planes:
+
+**Metrics plane** (PR 1) — aggregates over time:
 
 - :class:`MetricsRegistry` — labeled counter/gauge/histogram instruments
   with explicit :meth:`~MetricsRegistry.flush` to pluggable sinks
@@ -13,10 +15,28 @@ timing is ad-hoc wall-clock deltas in example scripts). Three pieces:
   cross-host step-time aggregation (straggler flag), and a per-host
   heartbeat.
 
-Recording is always on (instrument updates are a few dict ops);
-*emission* is opt-in: attach a sink via :func:`configure`,
-``fluxmpi_tpu.init(telemetry=...)``, or the ``FLUXMPI_TPU_TELEMETRY``
-env var. See docs/observability.md for the JSONL schema and recipes.
+**Trace plane** (PR 2) — the questions metrics can't answer ("which
+collective is every host stuck in?", "where did the ranks
+desynchronize?"):
+
+- :mod:`~fluxmpi_tpu.telemetry.tracing` — near-zero-cost spans
+  (:func:`span` / :func:`instant`) into a bounded ring, exported as
+  Chrome-trace/Perfetto JSON (merge hosts with
+  ``scripts/merge_traces.py``);
+- :mod:`~fluxmpi_tpu.telemetry.flight_recorder` — ring of the last N
+  collective launches with monotonic sequence numbers; cross-host dump
+  diffing (:func:`diff_flight_dumps`) localizes a desync to the exact
+  collective;
+- :mod:`~fluxmpi_tpu.telemetry.watchdog` — opt-in stall detector that
+  dumps all-thread stacks, the flight-recorder tail, open spans, and a
+  final registry flush to one artifact per host (also on ``SIGUSR1``).
+
+Recording is always on for metrics and the flight recorder (updates are
+a few dict/deque ops); span recording and the watchdog are opt-in
+(:func:`tracing.configure` / ``init(trace=..., watchdog=...)`` /
+``FLUXMPI_TPU_TRACE`` / ``FLUXMPI_TPU_WATCHDOG``). Metric *emission* is
+opt-in via :func:`configure`, ``fluxmpi_tpu.init(telemetry=...)``, or
+``FLUXMPI_TPU_TELEMETRY``. See docs/observability.md.
 """
 
 from __future__ import annotations
@@ -34,9 +54,13 @@ from .registry import (  # noqa: F401
 )
 from .schema import (  # noqa: F401
     SCHEMA,
+    TRACE_SCHEMA,
     validate_bench_record,
+    validate_flight_dump,
     validate_metric,
     validate_record,
+    validate_trace_export,
+    validate_watchdog_dump,
 )
 from .sinks import (  # noqa: F401
     ConsoleSink,
@@ -46,6 +70,28 @@ from .sinks import (  # noqa: F401
     Sink,
 )
 from .monitor import TrainingMonitor  # noqa: F401
+from . import tracing  # noqa: F401
+from .tracing import (  # noqa: F401
+    Tracer,
+    get_tracer,
+    instant,
+    set_tracer,
+    span,
+    trace_enabled,
+)
+from .flight_recorder import (  # noqa: F401
+    FlightRecorder,
+    diff_dumps as diff_flight_dumps,
+    get_flight_recorder,
+    set_flight_recorder,
+)
+from .watchdog import (  # noqa: F401
+    Watchdog,
+    arm_watchdog,
+    disarm_watchdog,
+    get_watchdog,
+    notify_progress,
+)
 
 __all__ = [
     "Counter",
@@ -55,15 +101,34 @@ __all__ = [
     "get_registry",
     "set_registry",
     "SCHEMA",
+    "TRACE_SCHEMA",
     "validate_record",
     "validate_metric",
     "validate_bench_record",
+    "validate_trace_export",
+    "validate_flight_dump",
+    "validate_watchdog_dump",
     "Sink",
     "JSONLSink",
     "MemorySink",
     "ConsoleSink",
     "NullSink",
     "TrainingMonitor",
+    "Tracer",
+    "get_tracer",
+    "set_tracer",
+    "span",
+    "instant",
+    "trace_enabled",
+    "FlightRecorder",
+    "get_flight_recorder",
+    "set_flight_recorder",
+    "diff_flight_dumps",
+    "Watchdog",
+    "arm_watchdog",
+    "disarm_watchdog",
+    "get_watchdog",
+    "notify_progress",
     "configure",
     "shutdown",
 ]
@@ -120,6 +185,17 @@ def configure(spec: Any = None) -> MetricsRegistry:
 
 
 def shutdown() -> None:
-    """Flush and detach every sink on the default registry (instruments
-    survive — a re-configured registry keeps its cumulative counters)."""
+    """Tear down the observability planes in failure-safe order: disarm
+    the watchdog, export the trace ring (when a path was configured),
+    then flush and detach every sink on the default registry
+    (instruments survive — a re-configured registry keeps its cumulative
+    counters)."""
+    try:
+        disarm_watchdog()
+    except Exception:
+        pass
+    try:
+        tracing.shutdown()
+    except Exception:
+        pass
     get_registry().close()

@@ -71,6 +71,17 @@ class TrainingMonitor:
         self._window: list[float] = []
         self._since_collect = 0
 
+    @property
+    def progress(self) -> int:
+        """Monotonic collect counter — the watchdog's stall-detection
+        source. Deliberately the *same* number as the
+        ``monitor.heartbeat`` counter (one source of truth: a watchdog
+        reading ``progress`` and a human tailing the JSONL heartbeat see
+        the identical liveness signal). Use as a custom
+        :class:`~fluxmpi_tpu.telemetry.Watchdog` source:
+        ``wd.add_source(lambda: mon.progress)``."""
+        return int(self.registry.counter("monitor.heartbeat").value)
+
     def observe_step(self, seconds: float) -> dict[str, Any] | None:
         """Record one step's duration; every ``interval`` steps, collect
         and flush. Returns the collect summary on collecting ticks."""
@@ -154,7 +165,16 @@ class TrainingMonitor:
         self._since_collect = 0
         # Heartbeat: this host is alive and flushing. The *absence* of
         # fresh heartbeats in a host's stream is the hung-rank signal.
+        # The same tick feeds stall detection: `progress` reads this
+        # counter, and the armed watchdog's global progress source is
+        # bumped here too — heartbeat and watchdog share one truth.
         self.registry.counter("monitor.heartbeat").inc()
         self.registry.gauge("monitor.heartbeat_unix").set(time.time())
+        try:
+            from .watchdog import notify_progress
+
+            notify_progress()
+        except Exception:  # liveness signalling must never fail a collect
+            pass
         summary["record"] = self.registry.flush()
         return summary

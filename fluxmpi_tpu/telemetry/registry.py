@@ -41,7 +41,26 @@ __all__ = [
     "MetricsRegistry",
     "get_registry",
     "set_registry",
+    "process_index_or_zero",
 ]
+
+
+def process_index_or_zero() -> int:
+    """Controller-process index for stamping records, without booting
+    the backend: jax.process_index() would initialize it, so only ask
+    once the runtime is up (pre-init records are single-process by
+    definition). Shared by every record producer in this package
+    (registry flushes, trace exports, flight/watchdog dumps)."""
+    try:
+        from ..runtime import is_initialized
+
+        if is_initialized():
+            import jax
+
+            return jax.process_index()
+    except Exception:
+        pass
+    return 0
 
 
 class Counter:
@@ -225,18 +244,7 @@ class MetricsRegistry:
             return [m.snapshot() for m in self._metrics.values()]
 
     def _process_index(self) -> int:
-        # jax.process_index() would boot the backend; only ask once the
-        # runtime is up (pre-init flushes are single-process by definition).
-        try:
-            from ..runtime import is_initialized
-
-            if is_initialized():
-                import jax
-
-                return jax.process_index()
-        except Exception:
-            pass
-        return 0
+        return process_index_or_zero()
 
     def flush(self, **extra: Any) -> dict[str, Any]:
         """Build one schema-v1 record from the current snapshot and write

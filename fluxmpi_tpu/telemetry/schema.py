@@ -28,11 +28,32 @@ Bench record (``bench.py`` stdout JSON line / BENCH_*.json "tail"):
 required keys ``metric`` (str), ``value`` (number), ``unit`` (str),
 ``vs_baseline`` (number); known optional keys are type-checked, unknown
 keys are allowed (forward compatibility).
+
+Trace-plane records (schema ``fluxmpi_tpu.trace/v1``) share one top-level
+shape — ``schema``, ``kind``, ``time_unix``, ``process`` — and dispatch
+on ``kind``:
+
+    kind="trace":            a Chrome-trace/Perfetto export — the
+                             standard ``traceEvents`` list ("X" complete
+                             spans with ``ts``/``dur`` in microseconds,
+                             "i" instants, "M" metadata) plus our
+                             top-level metadata. Perfetto ignores the
+                             extra keys, so the file loads directly.
+    kind="flight_recorder":  the last-N collective-launch ring — entries
+                             carry a monotonic per-process ``seq``, the
+                             op, path, nbytes, start stamp, duration,
+                             and a ``completed`` flag. Cross-host dumps
+                             diff by ``seq``.
+    kind="watchdog_dump":    the hang artifact — all-thread stacks, the
+                             flight-recorder tail, the open span stack,
+                             and a final telemetry/v1 registry flush.
 """
 
 from __future__ import annotations
 
 SCHEMA = "fluxmpi_tpu.telemetry/v1"
+
+TRACE_SCHEMA = "fluxmpi_tpu.trace/v1"
 
 METRIC_TYPES = ("counter", "gauge", "histogram")
 
@@ -141,3 +162,193 @@ def validate_bench_record(rec: object) -> list[str]:
     if "mfu" in rec and _is_number(rec["mfu"]) and not 0 <= rec["mfu"] <= 1:
         errors.append(f"'mfu' out of range [0, 1]: {rec['mfu']!r}")
     return errors
+
+
+# ---------------------------------------------------------------------------
+# Trace plane (schema "fluxmpi_tpu.trace/v1"): span exports, the collective
+# flight recorder, and watchdog hang dumps.
+# ---------------------------------------------------------------------------
+
+_TRACE_PHASES = ("X", "i", "I", "M", "C")
+
+
+def _validate_trace_header(rec: dict, kind: str) -> list[str]:
+    errors: list[str] = []
+    if rec.get("schema") != TRACE_SCHEMA:
+        errors.append(
+            f"'schema' must be {TRACE_SCHEMA!r}, got {rec.get('schema')!r}"
+        )
+    if rec.get("kind") != kind:
+        errors.append(f"'kind' must be {kind!r}, got {rec.get('kind')!r}")
+    if not _is_number(rec.get("time_unix")):
+        errors.append("missing numeric 'time_unix'")
+    proc = rec.get("process")
+    if not isinstance(proc, int) or isinstance(proc, bool) or proc < 0:
+        errors.append("'process' must be an int >= 0")
+    return errors
+
+
+def validate_trace_event(ev: object, where: str = "traceEvents[]") -> list[str]:
+    """Validate one Chrome-trace event object."""
+    if not isinstance(ev, dict):
+        return [f"{where}: not an object: {ev!r}"]
+    errors: list[str] = []
+    if not isinstance(ev.get("name"), str) or not ev.get("name"):
+        errors.append(f"{where}: missing/invalid 'name'")
+    ph = ev.get("ph")
+    if ph not in _TRACE_PHASES:
+        errors.append(
+            f"{where}: 'ph' must be one of {_TRACE_PHASES}, got {ph!r}"
+        )
+        return errors
+    if ph != "M":  # metadata events carry no timestamp
+        if not _is_number(ev.get("ts")):
+            errors.append(f"{where}: missing numeric 'ts'")
+        for key in ("pid", "tid"):
+            v = ev.get(key)
+            if not isinstance(v, int) or isinstance(v, bool):
+                errors.append(f"{where}: {key!r} must be an int")
+    if ph == "X":
+        dur = ev.get("dur")
+        if not _is_number(dur) or dur < 0:
+            errors.append(f"{where}: 'X' event needs numeric 'dur' >= 0")
+    args = ev.get("args")
+    if args is not None and not isinstance(args, dict):
+        errors.append(f"{where}: 'args' must be an object")
+    return errors
+
+
+def validate_trace_export(rec: object) -> list[str]:
+    """Validate a trace export file (kind="trace") — our metadata header
+    plus a Chrome-trace ``traceEvents`` list (the part Perfetto loads)."""
+    if not isinstance(rec, dict):
+        return [f"trace export is not an object: {type(rec).__name__}"]
+    errors = _validate_trace_header(rec, "trace")
+    events = rec.get("traceEvents")
+    if not isinstance(events, list):
+        errors.append("'traceEvents' must be a list")
+        return errors
+    for i, ev in enumerate(events):
+        errors.extend(validate_trace_event(ev, where=f"traceEvents[{i}]"))
+    return errors
+
+
+def validate_flight_dump(rec: object, where: str = "flight_recorder") -> list[str]:
+    """Validate a flight-recorder dump (kind="flight_recorder"). Entry
+    ``seq`` numbers must be strictly increasing — the cross-host diff
+    keys on them."""
+    if not isinstance(rec, dict):
+        return [f"{where}: not an object: {type(rec).__name__}"]
+    errors = _validate_trace_header(rec, "flight_recorder")
+    for key in ("sequence", "completed", "capacity"):
+        v = rec.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errors.append(f"{where}: {key!r} must be an int >= 0")
+    entries = rec.get("entries")
+    if not isinstance(entries, list):
+        errors.append(f"{where}: 'entries' must be a list")
+        return errors
+    prev_seq = 0
+    for i, e in enumerate(entries):
+        ew = f"{where}: entries[{i}]"
+        if not isinstance(e, dict):
+            errors.append(f"{ew}: not an object")
+            continue
+        seq = e.get("seq")
+        if not isinstance(seq, int) or isinstance(seq, bool) or seq < 1:
+            errors.append(f"{ew}: 'seq' must be an int >= 1")
+        elif seq <= prev_seq:
+            errors.append(
+                f"{ew}: 'seq' {seq} not strictly increasing (prev {prev_seq})"
+            )
+        else:
+            prev_seq = seq
+        for key in ("op", "path"):
+            if not isinstance(e.get(key), str) or not e.get(key):
+                errors.append(f"{ew}: missing/invalid {key!r} (str)")
+        if not _is_number(e.get("nbytes")) or e.get("nbytes") < 0:
+            errors.append(f"{ew}: 'nbytes' must be a number >= 0")
+        if not _is_number(e.get("time_unix")):
+            errors.append(f"{ew}: missing numeric 'time_unix'")
+        if not isinstance(e.get("completed"), bool):
+            errors.append(f"{ew}: 'completed' must be a bool")
+        dur = e.get("duration")
+        if dur is not None and not _is_number(dur):
+            errors.append(f"{ew}: 'duration' must be a number or null")
+    return errors
+
+
+def validate_watchdog_dump(rec: object) -> list[str]:
+    """Validate a watchdog hang dump (kind="watchdog_dump")."""
+    if not isinstance(rec, dict):
+        return [f"watchdog dump is not an object: {type(rec).__name__}"]
+    errors = _validate_trace_header(rec, "watchdog_dump")
+    if not isinstance(rec.get("reason"), str) or not rec.get("reason"):
+        errors.append("missing/invalid 'reason' (str)")
+    pid = rec.get("pid")
+    if not isinstance(pid, int) or isinstance(pid, bool) or pid <= 0:
+        errors.append("'pid' must be a positive int")
+    threads = rec.get("threads")
+    if not isinstance(threads, list) or not threads:
+        errors.append("'threads' must be a non-empty list")
+    else:
+        for i, t in enumerate(threads):
+            tw = f"threads[{i}]"
+            if not isinstance(t, dict):
+                errors.append(f"{tw}: not an object")
+                continue
+            if not isinstance(t.get("thread_id"), int):
+                errors.append(f"{tw}: 'thread_id' must be an int")
+            stack = t.get("stack")
+            if not isinstance(stack, list):
+                errors.append(f"{tw}: 'stack' must be a list")
+                continue
+            for j, fr in enumerate(stack):
+                fw = f"{tw}.stack[{j}]"
+                if not isinstance(fr, dict):
+                    errors.append(f"{fw}: not an object")
+                    continue
+                if not isinstance(fr.get("file"), str):
+                    errors.append(f"{fw}: missing 'file' (str)")
+                if not isinstance(fr.get("line"), int):
+                    errors.append(f"{fw}: missing 'line' (int)")
+                if not isinstance(fr.get("function"), str):
+                    errors.append(f"{fw}: missing 'function' (str)")
+    fr_dump = rec.get("flight_recorder")
+    if fr_dump is not None:
+        errors.extend(validate_flight_dump(fr_dump))
+    spans = rec.get("open_spans")
+    if not isinstance(spans, list):
+        errors.append("'open_spans' must be a list")
+    else:
+        for i, s in enumerate(spans):
+            if not isinstance(s, dict) or not isinstance(
+                s.get("thread_id"), int
+            ) or not isinstance(s.get("spans"), list):
+                errors.append(
+                    f"open_spans[{i}]: must be "
+                    "{'thread_id': int, 'spans': [...]}"
+                )
+    flush = rec.get("registry_flush")
+    if flush is not None:
+        for e in validate_record(flush):
+            errors.append(f"registry_flush: {e}")
+    return errors
+
+
+def validate_trace_file(rec: object) -> list[str]:
+    """Dispatch a trace-plane record (schema "fluxmpi_tpu.trace/v1") to
+    the validator matching its ``kind``."""
+    if not isinstance(rec, dict):
+        return [f"record is not an object: {type(rec).__name__}"]
+    kind = rec.get("kind")
+    if kind == "trace":
+        return validate_trace_export(rec)
+    if kind == "flight_recorder":
+        return validate_flight_dump(rec)
+    if kind == "watchdog_dump":
+        return validate_watchdog_dump(rec)
+    return [
+        f"'kind' must be 'trace', 'flight_recorder', or 'watchdog_dump', "
+        f"got {kind!r}"
+    ]

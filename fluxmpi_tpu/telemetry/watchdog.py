@@ -1,0 +1,414 @@
+"""Hang watchdog: turn "the job is stuck" into one artifact per host.
+
+Opt-in background thread (``fluxmpi_tpu.init(watchdog=...)`` or
+``FLUXMPI_TPU_WATCHDOG=<deadline seconds>``) that polls a set of
+monotonic progress sources — the module-level :func:`notify_progress`
+counter bumped by the train-step metrics hook, every
+:class:`~fluxmpi_tpu.data.DistributedDataLoader` batch, and
+:meth:`TrainingMonitor.collect`, plus the flight recorder's completed
+count — and, when none has advanced within ``deadline`` seconds, writes
+a dump file containing:
+
+- all-thread Python stacks (``sys._current_frames``) — where every
+  thread is stuck;
+- the flight-recorder tail — *which collective* this host is in
+  (diff dumps across hosts with
+  :func:`fluxmpi_tpu.telemetry.flight_recorder.diff_dumps` to find the
+  desync point);
+- the open span stack per thread — where inside the step timeline;
+- a final registry flush — the last metrics this host will report
+  (written through the registry's sinks too, so the JSONL stream gets a
+  terminal line).
+
+``SIGUSR1`` triggers the same dump on demand (``kill -USR1 <pid>`` on
+the host you are ssh'd into — no stall wait), reason ``"signal"``. The
+handler itself only sets a flag (a signal handler that took the
+registry lock could deadlock the main thread against itself); the
+watchdog thread writes the dump within ~0.5 s.
+
+The watchdog never touches the hot path: producers pay one int increment
+(:func:`notify_progress`), and detection is pull-based polling from the
+watchdog's own daemon thread. The poll itself is a few int compares.
+Clock and sources are injectable so stall detection is testable with a
+fake clock and zero real sleeps.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Callable
+
+from .registry import process_index_or_zero as _process_index
+from .schema import TRACE_SCHEMA
+
+__all__ = [
+    "Watchdog",
+    "arm_watchdog",
+    "disarm_watchdog",
+    "get_watchdog",
+    "notify_progress",
+    "configure",
+]
+
+_ENV_VAR = "FLUXMPI_TPU_WATCHDOG"
+_ENV_DIR = "FLUXMPI_TPU_WATCHDOG_DIR"
+_DEFAULT_DEADLINE_S = 300.0
+
+# Module-level progress counter: anything that proves liveness bumps it
+# (train-step hook, TrainingMonitor.collect, user code). An int += under
+# the GIL — the cheapest possible producer side.
+_progress = 0
+
+
+def notify_progress(n: int = 1) -> None:
+    """Signal forward progress to any armed watchdog."""
+    global _progress
+    _progress += n
+
+
+def _progress_value() -> int:
+    return _progress
+
+
+class Watchdog:
+    """Stall detector + dump writer.
+
+    Args:
+      deadline: seconds without observed progress before a stall dump.
+      poll_interval: seconds between checks on the background thread
+        (default ``min(deadline / 4, 10)``).
+      dump_dir: directory for dump files; the file is
+        ``fluxmpi_watchdog.<process>.json`` (stable name — the latest
+        dump wins; one artifact per host).
+      sources: iterable of zero-arg callables returning monotonic
+        numbers; progress = any of them advancing. Defaults to the
+        module :func:`notify_progress` counter and the default flight
+        recorder's completed count. NOTE the watchdog can only see
+        progress something reports: an instrumented step
+        (``metrics=``), a loader-fed loop, a monitor, eager
+        collectives, or your own :func:`notify_progress` calls. A loop
+        with none of these looks stalled by definition — wire one in
+        (one int increment) before arming, or the stall dump
+        false-positives on a healthy run.
+      registry: metrics registry for the final flush (default: the
+        global one).
+      tracer: tracer whose open-span stacks land in the dump (default:
+        the global one).
+      recorder: flight recorder whose tail lands in the dump (default:
+        the global one).
+      clock: monotonic time source (injectable for tests).
+
+    A stall dumps at most once per progress plateau: after a stall dump,
+    no further dump fires until progress resumes and stalls again (a
+    genuinely-dead job yields one artifact, not one per poll).
+    """
+
+    def __init__(
+        self,
+        deadline: float = _DEFAULT_DEADLINE_S,
+        *,
+        poll_interval: float | None = None,
+        dump_dir: str = ".",
+        sources: list[Callable[[], float]] | None = None,
+        registry: Any = None,
+        tracer: Any = None,
+        recorder: Any = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if deadline <= 0:
+            raise ValueError(f"deadline must be > 0, got {deadline}")
+        self.deadline = float(deadline)
+        self.poll_interval = (
+            poll_interval
+            if poll_interval is not None
+            else min(self.deadline / 4.0, 10.0)
+        )
+        self.dump_dir = dump_dir
+        if sources is None:
+            from .flight_recorder import get_flight_recorder
+
+            sources = [
+                _progress_value,
+                lambda: get_flight_recorder().completed_count,
+            ]
+        self.sources = list(sources)
+        self._registry = registry
+        self._tracer = tracer
+        self._recorder = recorder
+        self._clock = clock
+        self._thread: threading.Thread | None = None
+        self._stop: threading.Event | None = None
+        self._last_values: tuple | None = None
+        self._last_change: float | None = None
+        self._dumped_since_progress = False
+        self._signal_requested = False
+        self.last_dump_path: str | None = None
+        self._prev_sigusr1: Any = None
+
+    # -- progress ------------------------------------------------------
+
+    def add_source(self, fn: Callable[[], float]) -> None:
+        """Register another monotonic progress source."""
+        self.sources.append(fn)
+
+    def _read_sources(self) -> tuple:
+        values = []
+        for fn in self.sources:
+            try:
+                values.append(fn())
+            except Exception:
+                values.append(None)
+        return tuple(values)
+
+    def check(self) -> str | None:
+        """One poll step: note progress, or dump on a stall past the
+        deadline. Returns the dump path when a dump fired. Driven by the
+        background thread; callable directly (tests, manual loops)."""
+        now = self._clock()
+        values = self._read_sources()
+        if self._last_values is None or values != self._last_values:
+            self._last_values = values
+            self._last_change = now
+            self._dumped_since_progress = False
+            return None
+        if (
+            not self._dumped_since_progress
+            and now - self._last_change >= self.deadline
+        ):
+            self._dumped_since_progress = True
+            return self.dump("stall")
+        return None
+
+    # -- dumping -------------------------------------------------------
+
+    def _thread_stacks(self) -> list[dict[str, Any]]:
+        names = {t.ident: t.name for t in threading.enumerate()}
+        threads = []
+        for tid, frame in sys._current_frames().items():
+            stack = [
+                {"file": fr.filename, "line": fr.lineno, "function": fr.name}
+                for fr in traceback.extract_stack(frame)
+            ]
+            threads.append(
+                {
+                    "thread_id": tid,
+                    "name": names.get(tid, f"tid {tid}"),
+                    "stack": stack,
+                }
+            )
+        return threads
+
+    def build_dump(self, reason: str) -> dict[str, Any]:
+        """Assemble the dump record (schema ``fluxmpi_tpu.trace/v1`` /
+        kind ``watchdog_dump``) without writing it."""
+        from .registry import get_registry
+        from .tracing import get_tracer
+        from .flight_recorder import get_flight_recorder
+
+        tracer = self._tracer if self._tracer is not None else get_tracer()
+        recorder = (
+            self._recorder if self._recorder is not None
+            else get_flight_recorder()
+        )
+        registry = (
+            self._registry if self._registry is not None else get_registry()
+        )
+        record: dict[str, Any] = {
+            "schema": TRACE_SCHEMA,
+            "kind": "watchdog_dump",
+            "time_unix": time.time(),
+            "process": _process_index(),
+            "pid": os.getpid(),
+            "reason": reason,
+            "deadline_seconds": self.deadline,
+            "threads": self._thread_stacks(),
+            "open_spans": tracer.open_spans(),
+            "flight_recorder": recorder.dump(),
+        }
+        try:
+            # Also writes through the registry's sinks: the host's JSONL
+            # stream gets a terminal line even if the dump file is lost.
+            record["registry_flush"] = registry.flush(watchdog_reason=reason)
+        except Exception as exc:  # a broken sink must not kill the dump
+            record["registry_flush"] = None
+            record["registry_flush_error"] = repr(exc)
+        return record
+
+    def dump_path(self) -> str:
+        return os.path.join(
+            self.dump_dir, f"fluxmpi_watchdog.{_process_index()}.json"
+        )
+
+    def dump(self, reason: str = "manual") -> str:
+        """Write the dump file; returns its path."""
+        record = self.build_dump(reason)
+        path = self.dump_path()
+        os.makedirs(self.dump_dir or ".", exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(record, f, indent=1)
+        self.last_dump_path = path
+        print(
+            f"fluxmpi_tpu watchdog: {reason} dump written to {path}",
+            file=sys.stderr,
+        )
+        return path
+
+    # -- lifecycle -----------------------------------------------------
+
+    def _loop(self) -> None:
+        assert self._stop is not None
+        # Sub-tick waits so a SIGUSR1 request is served within ~0.5 s
+        # even on long poll intervals; check() keeps its own cadence.
+        tick = min(0.5, self.poll_interval)
+        since_check = 0.0
+        while not self._stop.wait(tick):
+            if self._signal_requested:
+                self._signal_requested = False
+                try:
+                    self.dump("signal")
+                except Exception:  # the watchdog must never kill the job
+                    pass
+            since_check += tick
+            if since_check >= self.poll_interval:
+                since_check = 0.0
+                try:
+                    self.check()
+                except Exception:
+                    pass
+
+    def _on_sigusr1(self, signum: int, frame: Any) -> None:
+        # Signal handlers run between bytecodes ON the main thread. The
+        # dump takes the registry lock (flush/snapshot) — if the signal
+        # lands while the main thread holds it, dumping inline would
+        # self-deadlock the process the watchdog exists to diagnose. So
+        # the handler only sets a plain flag (no locks of any kind);
+        # the daemon thread performs the dump within one sub-tick.
+        self._signal_requested = True
+
+    def arm(self, *, install_signal: bool = True) -> "Watchdog":
+        """Start the background poll thread (idempotent) and, from the
+        main thread, install the SIGUSR1 dump-on-demand handler."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self.check()  # seed the progress baseline at arm time
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="fluxmpi-watchdog", daemon=True
+        )
+        self._thread.start()
+        if install_signal:
+            try:
+                self._prev_sigusr1 = signal.signal(
+                    signal.SIGUSR1, self._on_sigusr1
+                )
+            except (ValueError, OSError, AttributeError):
+                # Not the main thread / platform without SIGUSR1: the
+                # stall path still works, only dump-on-demand is lost.
+                self._prev_sigusr1 = None
+        return self
+
+    def disarm(self) -> None:
+        """Stop the poll thread and restore the previous SIGUSR1 handler."""
+        if self._stop is not None:
+            self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if self._prev_sigusr1 is not None:
+            try:
+                signal.signal(signal.SIGUSR1, self._prev_sigusr1)
+            except (ValueError, OSError):
+                pass
+            self._prev_sigusr1 = None
+
+    @property
+    def armed(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# Global watchdog wiring (init kwarg / env var)
+# ---------------------------------------------------------------------------
+
+_active: Watchdog | None = None
+
+
+def get_watchdog() -> Watchdog | None:
+    """The armed watchdog, if any."""
+    return _active
+
+
+def arm_watchdog(watchdog: Watchdog | None = None, **kwargs: Any) -> Watchdog:
+    """Arm a watchdog as THE process watchdog (disarming any previous
+    one). ``arm_watchdog()`` builds one from kwargs (see
+    :class:`Watchdog`); pass an instance to arm custom wiring."""
+    global _active
+    if _active is not None:
+        _active.disarm()
+    _active = watchdog if watchdog is not None else Watchdog(**kwargs)
+    _active.arm()
+    return _active
+
+
+def disarm_watchdog() -> None:
+    """Disarm and forget the process watchdog (idempotent)."""
+    global _active
+    if _active is not None:
+        _active.disarm()
+        _active = None
+
+
+def configure(spec: Any = None) -> Watchdog | None:
+    """Wire the watchdog from a one-value spec (mirror of
+    :func:`fluxmpi_tpu.telemetry.configure`):
+
+    - ``None`` — read ``FLUXMPI_TPU_WATCHDOG`` (same forms below; no-op
+      when unset/empty/``0``);
+    - ``False`` / ``"0"`` — disarm;
+    - ``True`` / ``"1"`` — arm with the default deadline (300 s);
+    - a number (or numeric string) — arm with that deadline in seconds;
+    - a :class:`Watchdog` — arm it.
+
+    Dump directory comes from ``FLUXMPI_TPU_WATCHDOG_DIR`` (default
+    ``.``). Called by ``fluxmpi_tpu.init(watchdog=...)``; idempotent —
+    re-arming with the same deadline keeps the armed instance.
+    """
+    if spec is None:
+        spec = os.environ.get(_ENV_VAR)
+        if spec is None or spec == "":
+            return _active
+    if spec is False or spec == "0":
+        disarm_watchdog()
+        return None
+    if isinstance(spec, Watchdog):
+        if spec is _active and spec.armed:
+            return spec
+        return arm_watchdog(spec)
+    if spec is True or spec == "1":
+        deadline = _DEFAULT_DEADLINE_S
+    else:
+        try:
+            deadline = float(spec)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"watchdog spec must be a bool, a deadline in seconds, or "
+                f"a Watchdog; got {spec!r}"
+            ) from None
+        if deadline <= 0:
+            disarm_watchdog()
+            return None
+    dump_dir = os.environ.get(_ENV_DIR, ".")
+    if (
+        _active is not None
+        and _active.armed
+        and _active.deadline == deadline
+        and _active.dump_dir == dump_dir
+    ):
+        return _active  # idempotent init() replay
+    return arm_watchdog(deadline=deadline, dump_dir=dump_dir)

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import contextlib
 import time
+import warnings
 from typing import Any, Iterator
 
 import jax
@@ -19,17 +20,59 @@ __all__ = ["profile_trace", "step_timer"]
 
 
 @contextlib.contextmanager
-def profile_trace(logdir: str, *, host_only: bool = False) -> Iterator[None]:
+def profile_trace(
+    logdir: str, *, all_hosts: bool = False, host_only: bool | None = None
+) -> Iterator[None]:
     """Capture a profiler trace of the enclosed block into ``logdir``.
 
-    Only the lead process traces (device activity is mirrored across DP
-    replicas). View with TensorBoard's profile plugin or Perfetto.
+    By default only the lead process traces — device activity is
+    mirrored across DP replicas, so one host's XPlane is usually the
+    whole picture. Pass ``all_hosts=True`` to trace on every process
+    (straggler hunts, where the point is comparing hosts); give each
+    host its own ``logdir`` then, or the writers collide.
+
+    ``host_only`` is the deprecated spelling of this switch: it was
+    documented as "only the lead process traces" but implemented so
+    ``host_only=True`` made *every* process trace. The shim preserves
+    each caller's old *actual* behavior (``all_hosts = host_only``) —
+    ``host_only=False`` callers keep their correct lead-only traces,
+    ``host_only=True`` callers keep tracing everywhere — while the
+    deprecation warning points at the honest spelling.
+
+    View with TensorBoard's profile plugin or Perfetto. For the
+    always-on, in-process span timeline (no XPlane machinery), see
+    :mod:`fluxmpi_tpu.telemetry.tracing`.
     """
-    if host_only or jax.process_index() == 0:
+    if host_only is not None:
+        warnings.warn(
+            "profile_trace(host_only=...) is deprecated: the flag's old "
+            "behavior contradicted its documentation (host_only=True "
+            "traced on EVERY process). Behavior is preserved; spell it "
+            "all_hosts=True to trace on every process, or omit the flag "
+            "to trace on the lead process only.",
+            DeprecationWarning,
+            stacklevel=3,
+        )
+        all_hosts = bool(host_only)
+    if all_hosts or jax.process_index() == 0:
         with jax.profiler.trace(logdir):
             yield
     else:  # pragma: no cover - multihost only
         yield
+
+
+# One cached jitted sentinel for step_timer's no-watch fallback. A fresh
+# `jax.jit(lambda x: x + 1)` per call would be a NEW jit cache entry each
+# time (lambda identity keys the cache), so every timed step would
+# retrace — the drain itself would dirty the timing it exists to honor.
+_sentinel_bump = None
+
+
+def _bump_fn():
+    global _sentinel_bump
+    if _sentinel_bump is None:
+        _sentinel_bump = jax.jit(lambda x: x + 1)
+    return _sentinel_bump
 
 
 class _TimerHandle:
@@ -72,7 +115,7 @@ def step_timer(
     else:
         import jax.numpy as jnp
 
-        bump = jax.jit(lambda x: x + 1)
+        bump = _bump_fn()
         for d in jax.local_devices():
             bump(jax.device_put(jnp.zeros(()), d)).block_until_ready()
     elapsed = time.perf_counter() - t0

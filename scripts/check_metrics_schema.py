@@ -1,6 +1,7 @@
 #!/usr/bin/env python
-"""Validate bench JSON and telemetry JSONL files against the documented
-schema (fluxmpi_tpu/telemetry/schema.py — the single source of truth).
+"""Validate bench JSON, telemetry JSONL, and trace-plane files against
+the documented schemas (fluxmpi_tpu/telemetry/schema.py — the single
+source of truth).
 
 Usage:
     python scripts/check_metrics_schema.py [FILE ...]
@@ -8,12 +9,17 @@ Usage:
 - ``*.jsonl`` files: every line must be a valid telemetry flush record
   (schema "fluxmpi_tpu.telemetry/v1"); a line carrying a ``bench`` key
   must also embed a valid bench record.
-- ``*.json`` files: a bench record — either bench.py's raw output
+- ``*.json`` files carrying ``"schema": "fluxmpi_tpu.trace/v1"``:
+  dispatched on ``kind`` — a trace export (``Tracer.export`` /
+  ``scripts/merge_traces.py`` output), a flight-recorder dump, or a
+  watchdog hang dump.
+- other ``*.json`` files: a bench record — either bench.py's raw output
   (``{"metric": ...}``) or a driver BENCH_*.json wrapper whose ``tail``
   holds the JSON line bench.py printed.
 
 With no arguments, validates every ``BENCH_*.json`` in the repo root —
-the PR-time drift check (wired into tests/test_telemetry.py).
+the PR-time drift check (wired into tests/test_telemetry.py; the
+trace-plane paths are exercised by tests/test_tracing.py).
 
 The schema module is loaded by file path, NOT via ``import fluxmpi_tpu``:
 this script must stay runnable in a second without booting jax or any
@@ -82,6 +88,10 @@ def check_file(path: str, schema) -> list[str]:
         data = json.loads(content)
     except json.JSONDecodeError as exc:
         return [f"{path}: not JSON: {exc}"]
+    if isinstance(data, dict) and data.get("schema") == schema.TRACE_SCHEMA:
+        # Trace-plane file (span export / flight recorder / watchdog
+        # dump): validate_trace_file dispatches on its 'kind'.
+        return [f"{path}: {e}" for e in schema.validate_trace_file(data)]
     rec = _bench_record_from(data) if isinstance(data, dict) else None
     if rec is None:
         # A wrapper with no bench line is a bench that never ran — not a

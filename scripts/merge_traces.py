@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Merge per-host trace exports into one Perfetto-loadable file.
+
+Usage:
+    python scripts/merge_traces.py -o merged.json trace.0.json trace.1.json ...
+    python scripts/merge_traces.py -o merged.json 'traces/trace.*.json'
+
+Each input is a ``fluxmpi_tpu.trace/v1`` / kind="trace" export (what
+``Tracer.export(path)`` / ``FLUXMPI_TPU_TRACE=<path>`` writes, one per
+host). Span timestamps are wall-clock-anchored microseconds, so events
+from different hosts land on one shared timeline without re-basing —
+cross-host skew is NTP skew, small enough to read collective alignment
+at step granularity. Every host keeps its own pid lane (relabeled
+``host <process>``), so Perfetto renders one process group per host.
+
+The output is itself a valid kind="trace" record (extra top-level keys
+are Chrome-trace metadata, which Perfetto ignores), so
+``scripts/check_metrics_schema.py merged.json`` validates it.
+
+Like check_metrics_schema.py, the schema module is loaded by file path —
+this script must stay runnable in a second without importing jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import importlib.util
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_schema():
+    path = os.path.join(_REPO, "fluxmpi_tpu", "telemetry", "schema.py")
+    spec = importlib.util.spec_from_file_location("_fluxmpi_schema", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def merge(records: list[dict]) -> dict:
+    """Merge kind="trace" records into one. Each host's events are
+    re-pidded to its ``process`` index — original pids can collide
+    across hosts (containerized SPMD launches everything as pid 1),
+    which would silently fold two hosts into one Perfetto lane — and
+    process_name metadata is rewritten to ``host <process>`` so the
+    merged view is attributable at a glance."""
+    events: list[dict] = []
+    seen_processes: list[int] = []
+    for rec in records:
+        process = int(rec.get("process", 0))
+        seen_processes.append(process)
+        for ev in rec.get("traceEvents", []):
+            if "pid" in ev:
+                ev = {**ev, "pid": process}
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                ev = {
+                    **ev,
+                    "args": {"name": f"host {process}"},
+                }
+            events.append(ev)
+    # Stable render order: metadata first, then by timestamp.
+    events.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0)))
+    return {
+        "schema": _load_schema().TRACE_SCHEMA,
+        "kind": "trace",
+        "time_unix": time.time(),
+        # The merged file spans hosts; 'process' names the lead by
+        # convention so the record stays schema-valid.
+        "process": min(seen_processes) if seen_processes else 0,
+        "merged_from": sorted(seen_processes),
+        "displayTimeUnit": "ms",
+        "traceEvents": events,
+    }
+
+
+def main(argv: list[str]) -> int:
+    parser = argparse.ArgumentParser(
+        description="Merge per-host fluxmpi_tpu trace exports into one "
+        "Perfetto-loadable Chrome-trace JSON."
+    )
+    parser.add_argument(
+        "-o", "--output", required=True, help="merged output path"
+    )
+    parser.add_argument(
+        "inputs", nargs="+",
+        help="per-host trace JSON files (globs are expanded)",
+    )
+    args = parser.parse_args(argv)
+
+    paths: list[str] = []
+    for pattern in args.inputs:
+        matched = sorted(glob.glob(pattern))
+        if matched:
+            paths.extend(matched)
+        else:
+            paths.append(pattern)  # literal path: missing files error below
+
+    schema = _load_schema()
+    records: list[dict] = []
+    errors: list[str] = []
+    for path in paths:
+        if not os.path.exists(path):
+            errors.append(f"{path}: no such file")
+            continue
+        with open(path, "r", encoding="utf-8") as f:
+            try:
+                rec = json.load(f)
+            except json.JSONDecodeError as exc:
+                errors.append(f"{path}: not JSON: {exc}")
+                continue
+        errs = schema.validate_trace_export(rec)
+        if errs:
+            errors.extend(f"{path}: {e}" for e in errs)
+            continue
+        records.append(rec)
+    for e in errors:
+        print(e, file=sys.stderr)
+    if not records:
+        print("merge_traces: no valid trace files", file=sys.stderr)
+        return 1
+    merged = merge(records)
+    with open(args.output, "w", encoding="utf-8") as f:
+        json.dump(merged, f)
+    print(
+        f"merge_traces: {len(records)} host trace(s), "
+        f"{len(merged['traceEvents'])} event(s) -> {args.output}"
+        + (f" ({len(errors)} input error(s))" if errors else "")
+    )
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
