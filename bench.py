@@ -47,6 +47,15 @@ Env knobs:
   FLUXMPI_TPU_BENCH_JSONL     also emit results through the telemetry
                               JSONL sink at this path (schema-validated
                               by scripts/check_metrics_schema.py)
+  FLUXMPI_TPU_BENCH_STEPS     cap the measured steps per workload (smoke /
+                              quick-iteration knob; slope timing keeps
+                              working down to a handful of steps)
+  FLUXMPI_TPU_BENCH_SMOKE     "1" = smoke mode: skip the probe ladder, run
+                              the mlp config + the cpu-virtual scaling
+                              pair with tiny budgets on CPU, print the
+                              same JSON shape. Runs inside tier-1 CI
+                              (tests/test_bench.py) so bench/schema
+                              breakage is caught before a round.
   FLUXMPI_TPU_BENCH_TRACE_DIR enable span tracing in each bench child and
                               export a Chrome-trace JSON per config into
                               this directory (trace.<config>.json —
@@ -213,6 +222,49 @@ def _steps_per_sec(step, state, data, warmup: int, steps: int):
     return rate, state
 
 
+def _dispatch_probe(mesh) -> dict | None:
+    """Per-dispatch host cost of a trivial jitted program over the mesh —
+    the null-step floor under every train step. Slope-timed chained
+    dispatches (the chain serializes on data dependence, so the measured
+    cost is enqueue + scheduling, not compute). This is the number that
+    grows with device count and that scan_steps/pipelining amortize; it
+    makes the synthetic-vs-dispatch gap attributable in one run."""
+    try:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from fluxmpi_tpu import config as fm_config
+
+        n_dev = int(np.prod(list(mesh.shape.values())))
+        axis = (
+            fm_config.DP_AXIS_NAME
+            if fm_config.DP_AXIS_NAME in mesh.shape
+            else tuple(mesh.shape)[0]
+        )
+        x = jax.device_put(
+            jnp.zeros((n_dev,), jnp.float32), NamedSharding(mesh, P(axis))
+        )
+        bump = jax.jit(lambda v: v + 1.0)
+        _sync(bump(x))  # compile outside the timed region
+
+        def run(n: int) -> float:
+            t0 = time.perf_counter()
+            y = x
+            for _ in range(n):
+                y = bump(y)
+            _sync(y[0])
+            return time.perf_counter() - t0
+
+        n1, n2 = 30, 150
+        t1, t2 = run(n1), run(n2)
+        per = (t2 - t1) / (n2 - n1) if t2 > t1 else t2 / n2
+        return {"per_dispatch_us": round(per * 1e6, 1), "n_dev": n_dev}
+    except Exception as exc:  # pragma: no cover - diagnostics only
+        print(f"bench: dispatch probe failed: {exc!r}", file=sys.stderr)
+        return None
+
+
 def _cost_analysis_flops(step, state, data) -> float | None:
     """FLOPs per compiled step straight from XLA's cost model, if exposed."""
     try:
@@ -270,6 +322,7 @@ def _bench_workload(
     loader_fed: bool = False,
     value_scale: float = 1.0,
     init_fn=None,
+    default_scan_steps: int = 1,
 ):
     """Shared harness: synthetic batch → compiled DP train step → per-chip
     throughput. ``make_model_batch(n_dev)`` returns
@@ -307,7 +360,18 @@ def _bench_workload(
     # FLOPs below are per CALL, so K scales both.
     remat_env = os.environ.get("FLUXMPI_TPU_BENCH_REMAT", "0")
     remat = "dots" if remat_env == "dots" else remat_env == "1"
-    scan = max(1, int(os.environ.get("FLUXMPI_TPU_BENCH_SCAN_STEPS", "1")))
+    scan = max(1, int(os.environ.get(
+        "FLUXMPI_TPU_BENCH_SCAN_STEPS", str(default_scan_steps)
+    )))
+    if scan > 1:
+        # Keep measured wall time roughly constant: each call is scan
+        # updates, so fewer calls cover the same optimizer-step count.
+        # Floor of 10 calls: the two-point slope needs enough calls per
+        # leg or run-to-run variance swamps the measurement.
+        steps = max(10, steps // scan)
+    cap = os.environ.get("FLUXMPI_TPU_BENCH_STEPS")
+    if cap:
+        steps = max(2, min(steps, int(cap)))
     step = make_train_step(loss_fn, optimizer, mesh=mesh, style="auto",
                            remat=remat)
     state = replicate(TrainState.create(params, optimizer, model_state), mesh)
@@ -369,22 +433,44 @@ def _bench_workload(
     if scan > 1:
         result["scan_steps"] = scan
 
+    dispatch = _dispatch_probe(mesh)
+    if dispatch is not None:
+        result["dispatch"] = dispatch
+
     if loader_fed:
         fed = _loader_fed_rate(step=step, state=state, x=x, y=y,
                                mesh=mesh, n_dev=n_dev)
         if fed is not None:
-            result["loader_fed_" + metric_name] = round(fed, ndigits)
+            result["loader_fed_" + metric_name] = round(
+                fed["per_chip"], ndigits
+            )
+            # Which loader path produced the number — a regression from a
+            # silent device_gather→host fallback (e.g. the dataset
+            # outgrowing the staging budget) must be attributable from
+            # the record alone.
+            result["loader_fed_path"] = fed["path"]
+            if fed.get("assembly_samples_per_sec") is not None:
+                # Assembly-only (loader iteration, no train step): the
+                # third leg of the synthetic / loader-fed / assembly-only
+                # breakdown, now ON the schema'd record instead of a
+                # stderr line invisible to the trajectory.
+                result["assembly_samples_per_sec"] = round(
+                    fed["assembly_samples_per_sec"], 1
+                )
     return result
 
 
-def _loader_fed_rate(*, step, state, x, y, mesh, n_dev) -> float | None:
+def _loader_fed_rate(*, step, state, x, y, mesh, n_dev) -> dict | None:
     """Re-time the same compiled step drawing batches through
-    DistributedDataLoader + the C++ NativePrefetcher over host numpy data —
-    host→device transfer included (the input pipeline must be on the
-    measured path). Note: on a tunneled dev TPU every batch crosses the
-    tunnel, so this number is transfer-bound there; on a real TPU VM the
-    transfer is local PCIe/DMA."""
-    import jax  # noqa: F401  (device runtime must be up)
+    DistributedDataLoader — the device-gather fast path when the dataset
+    qualifies (array-backed, fits the staging budget), the C++
+    NativePrefetcher + per-batch transfer otherwise; either way the input
+    pipeline is on the measured path. Returns ``{"per_chip": rate,
+    "assembly_samples_per_sec": rate, "path": ...}`` so the
+    synthetic/loader-fed/assembly-only breakdown lands on the schema'd
+    record. Note: on a tunneled dev TPU every host-path batch crosses the
+    tunnel; on a real TPU VM the transfer is local PCIe/DMA."""
+    import jax
 
     from fluxmpi_tpu.data import ArrayDataset, DistributedDataLoader
 
@@ -400,7 +486,12 @@ def _loader_fed_rate(*, step, state, x, y, mesh, n_dev) -> float | None:
         host_x = np.concatenate([host_x] * reps, axis=0)[:n_samples]
         host_y = np.concatenate([host_y] * reps, axis=0)[:n_samples]
         dataset = ArrayDataset((host_x, host_y))
+        # ONE loader for both measurements: its (mesh, axis) sharding and
+        # any device-gather staging are built once and reused across
+        # epochs — rebuilding per run would re-measure setup, not steady
+        # state.
         loader = DistributedDataLoader(dataset, batch, mesh=mesh)
+        gather_path = loader._use_device_gather(loader._array_backing())
 
         def run(n_steps: int, state):
             done = 0
@@ -415,13 +506,18 @@ def _loader_fed_rate(*, step, state, x, y, mesh, n_dev) -> float | None:
             _sync(loss)
             return n_steps / (time.perf_counter() - t0), state
 
-        _, state = run(2, state)  # warmup: prefetcher spin-up
+        _, state = run(2, state)  # warmup: staging / prefetcher spin-up
         rate, state = run(8, state)
+        out = {
+            "per_chip": batch * rate / n_dev,
+            "path": "device_gather" if gather_path else "host",
+            "assembly_samples_per_sec": None,
+        }
 
-        # Diagnostic sub-rates so a gap vs synthetic is attributable in
-        # ONE session: host-side batch assembly alone (loader iteration,
-        # no step — includes the C++ gather and the host→device
-        # transfers it initiates), printed to stderr, never the metric.
+        # Assembly-only sub-rate so a gap vs synthetic is attributable in
+        # ONE session: loader iteration with no train step — batch
+        # production (device gather dispatch, or C++ gather + the
+        # host→device transfers it initiates) drained per batch.
         try:
             t0 = time.perf_counter()
             n_loader = 0
@@ -429,16 +525,12 @@ def _loader_fed_rate(*, step, state, x, y, mesh, n_dev) -> float | None:
                 for data in loader:
                     jax.block_until_ready(data)
                     n_loader += 1
-            assembly = batch * n_loader / (time.perf_counter() - t0)
-            print(
-                f"bench: loader diagnostics: assembly+transfer alone "
-                f"{assembly:.1f} samples/s vs loader-fed "
-                f"{batch * rate:.1f}",
-                file=sys.stderr,
+            out["assembly_samples_per_sec"] = (
+                batch * n_loader / (time.perf_counter() - t0)
             )
         except Exception:
             pass
-        return batch * rate / n_dev
+        return out
     except Exception as exc:  # pragma: no cover - diagnostics only
         print(f"bench: loader-fed path failed: {exc!r}", file=sys.stderr)
         return None
@@ -540,6 +632,14 @@ def _bench_mlp():
         # 4-layer MLP 1→256→256→256→1: 2·Σ(in·out) MACs... FLOPs = 2×,
         # train step ≈ 3× fwd.
         analytic_flops_per_sample=3 * 2 * (256 + 256 * 256 * 2 + 256),
+        loader_fed=True,
+        # The mlp step is small enough that per-dispatch host cost is a
+        # measurable fraction of it; the steady-state default is the
+        # pipelined multi-step path (8 updates per dispatch — measured
+        # +35% single-chip, +19% at dp8 on the 2-core CPU smoke host).
+        # FLUXMPI_TPU_BENCH_SCAN_STEPS=1 restores per-step dispatch for
+        # A/B; rates and FLOPs account for the scan width either way.
+        default_scan_steps=8,
     )
 
 
@@ -1095,7 +1195,33 @@ def _run_scaling(
         "per_chip_at_dp1": r1["value"],
         "per_chip_at_dpN": rn["value"],
         "scaling_efficiency": _scaling_efficiency(r1["value"], rn["value"]),
+        # Per-n_dev attribution: where the efficiency goes — compiled
+        # step (synthetic), input pipeline (loader_fed / assembly), or
+        # dispatch floor. Keys mirror the child records they come from.
+        "breakdown": {
+            "dp1": _leg_breakdown(r1),
+            "dpN": _leg_breakdown(rn),
+        },
     }
+
+
+def _leg_breakdown(rec: dict) -> dict:
+    """Lift one scaling child's diagnostic sub-rates into the scaling
+    block (synthetic vs loader-fed vs assembly-only vs dispatch floor)."""
+    out: dict = {"synthetic": rec.get("value")}
+    for key, val in rec.items():
+        if key.startswith("loader_fed_") and key != "loader_fed_path":
+            out["loader_fed"] = val
+    if rec.get("loader_fed_path") is not None:
+        out["loader_path"] = rec["loader_fed_path"]
+    if rec.get("assembly_samples_per_sec") is not None:
+        out["assembly"] = rec["assembly_samples_per_sec"]
+    dispatch = rec.get("dispatch")
+    if isinstance(dispatch, dict):
+        out["dispatch_us"] = dispatch.get("per_dispatch_us")
+    if "scan_steps" in rec:
+        out["scan_steps"] = rec["scan_steps"]
+    return out
 
 
 def _emit_telemetry(result: dict) -> None:
@@ -1134,6 +1260,32 @@ def _emit_telemetry(result: dict) -> None:
         print(f"bench: telemetry emit failed: {exc!r}", file=sys.stderr)
 
 
+def _run_smoke(remaining) -> None:
+    """Smoke mode: the full bench contract — child spawn, JSON shape,
+    schema, dispatch probe, loader-fed breakdown, (optionally) the
+    scaling pair — in well under a minute on CPU, no probe ladder. This
+    is what tier-1 CI runs (tests/test_bench.py) so bench/schema
+    breakage is caught before a round, not during one.
+    ``FLUXMPI_TPU_BENCH_SMOKE_SCALING=0`` skips the scaling pair (the
+    tier-1 test does, for suite-budget reasons; the slow-marked variant
+    covers it)."""
+    os.environ.setdefault("FLUXMPI_TPU_BENCH_STEPS", "6")
+    os.environ.setdefault("FLUXMPI_TPU_BENCH_MLP_BATCH", "256")
+    result = _run_child("mlp", 240.0, "cpu")
+    if result is None:
+        result = {"metric": "bench_failed", "value": 0.0, "unit": "none",
+                  "vs_baseline": 0.0}
+    # Marked on failures too: a CI smoke crash must never read as a real
+    # benchmark round in the shared JSONL trajectory.
+    result["smoke"] = 1
+    if os.environ.get("FLUXMPI_TPU_BENCH_SMOKE_SCALING", "1") == "1":
+        scaling = _run_scaling(min(remaining(), 340.0), None, None)
+        if scaling is not None:
+            result["scaling"] = scaling
+    _emit_telemetry(result)
+    print(json.dumps(result))
+
+
 def main() -> None:
     t_start = time.monotonic()
     budget = float(
@@ -1142,6 +1294,10 @@ def main() -> None:
 
     def remaining() -> float:
         return budget - (time.monotonic() - t_start)
+
+    if os.environ.get("FLUXMPI_TPU_BENCH_SMOKE") == "1":
+        _run_smoke(remaining)
+        return
 
     forced = os.environ.get("FLUXMPI_TPU_BENCH_CONFIG")
     if forced and forced not in _CHILD_FNS:

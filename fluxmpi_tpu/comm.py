@@ -151,11 +151,19 @@ def _axis_and_size(mesh: Mesh, axis_name: str | None) -> tuple[str, int]:
     return name, mesh.shape[name]
 
 
+@functools.lru_cache(maxsize=None)
+def _ranks_sharding(mesh: Mesh, name: str, ndim: int) -> NamedSharding:
+    # One NamedSharding per (mesh, axis, rank-count) — constructing a fresh
+    # one per call was measurable per-batch/per-collective overhead.
+    return NamedSharding(mesh, P(name, *([None] * (ndim - 1))))
+
+
 def shard_ranks(
     x: Any, mesh: Mesh | None = None, axis_name: str | None = None
 ) -> jax.Array:
     """Lay a stacked per-worker value ``x`` (leading axis = world size) out
-    across the mesh, one slice per worker."""
+    across the mesh, one slice per worker. An input already carrying the
+    target layout is returned as-is (no restaging device_put)."""
     mesh = mesh or global_mesh()
     name, size = _axis_and_size(mesh, axis_name)
     x = jnp.asarray(x)
@@ -164,8 +172,12 @@ def shard_ranks(
             f"per-worker value must have leading axis == world size {size}, "
             f"got shape {x.shape}"
         )
-    spec = P(name, *([None] * (x.ndim - 1)))
-    return jax.device_put(x, NamedSharding(mesh, spec))
+    sharding = _ranks_sharding(mesh, name, x.ndim)
+    if isinstance(x, jax.Array) and x.sharding.is_equivalent_to(
+        sharding, x.ndim
+    ):
+        return x
+    return jax.device_put(x, sharding)
 
 
 def unshard_ranks(x: jax.Array) -> np.ndarray:
@@ -238,7 +250,29 @@ def _host_collective(
 # dumps localizes a desync (see telemetry/flight_recorder.py). When
 # tracing is enabled the same t0/t1 pair lands on the span timeline as a
 # comm.<op> event. Both are one deque append — no locks on this path.
+#
+# Zero-cost-when-off: one `_instrumentation_on()` check (three attribute
+# reads) gates ALL of the above. With the registry, the flight recorder,
+# and the tracer disabled, a collective performs no perf_counter reads, no
+# labeled-handle lookups, and no flight/trace appends. When on, the three
+# labeled handles per (op, path) are resolved once and cached — the
+# steady-state cost is attribute reads + float ops, not three registry
+# dict lookups per call (they key by sorted label tuples, which allocates).
 # ---------------------------------------------------------------------------
+
+# (op, path) -> (registry, registry.version, calls, bytes, block_seconds).
+# Invalidated by identity/version mismatch: set_registry() swaps the
+# registry object, reset() bumps the version (orphaning the instruments).
+_handles: dict[tuple[str, str], tuple[Any, int, Any, Any, Any]] = {}
+
+
+def _instrumentation_on() -> bool:
+    """The single fast-guard for the collective hot path."""
+    return (
+        _telemetry_registry().enabled
+        or _flight_recorder().enabled
+        or _tracing.get_tracer().enabled
+    )
 
 
 def _begin_op(op_name: str, path: str, nbytes: int) -> Any:
@@ -271,11 +305,27 @@ def _record_op(
             "comm." + op_name, t0, t1, path=path, nbytes=int(nbytes)
         )
         reg = _telemetry_registry()
-        reg.counter("comm.calls", op=op_name, path=path).inc()
-        reg.counter("comm.bytes", op=op_name, path=path).inc(float(nbytes))
-        reg.histogram("comm.block_seconds", op=op_name, path=path).observe(
-            t1 - t0
-        )
+        if not reg.enabled:
+            return
+        key = (op_name, path)
+        cached = _handles.get(key)
+        if (
+            cached is None
+            or cached[0] is not reg
+            or cached[1] != reg.version
+        ):
+            cached = (
+                reg,
+                reg.version,
+                reg.counter("comm.calls", op=op_name, path=path),
+                reg.counter("comm.bytes", op=op_name, path=path),
+                reg.histogram("comm.block_seconds", op=op_name, path=path),
+            )
+            _handles[key] = cached
+        _, _, calls, nbytes_total, block = cached
+        calls.inc()
+        nbytes_total.inc(float(nbytes))
+        block.observe(t1 - t0)
     except Exception:  # instrumentation must never take down a collective
         pass
 
@@ -289,7 +339,10 @@ def _run_collective(
     axis_name: str | None = None,
     donate: bool = False,
 ) -> jax.Array:
-    t0 = time.perf_counter()
+    # One cheap guard up front: the fully-off path (no telemetry, no
+    # flight recorder, no tracing) must do no timing and no dict work.
+    instrumented = _instrumentation_on()
+    t0 = time.perf_counter() if instrumented else 0.0
     mesh = mesh or global_mesh()
     name, size = _axis_and_size(mesh, axis_name)
     if not 0 <= root < size:
@@ -311,6 +364,8 @@ def _run_collective(
                 f"per-worker value must have leading axis == world size "
                 f"{size}, got shape {xs.shape}"
             )
+        if not instrumented:
+            return _host_collective(xs, kind, op, root, mesh, name)
         flight = _begin_op(kind, "host", xs.nbytes)
         try:
             out = _host_collective(xs, kind, op, root, mesh, name)
@@ -343,6 +398,8 @@ def _run_collective(
             stacklevel=3,
         )
     fn = _collective_fn(mesh, name, kind, op, root, donate or fresh)
+    if not instrumented:
+        return fn(xs)
     nbytes = xs.nbytes
     flight = _begin_op(kind, "device", nbytes)
     try:
@@ -483,15 +540,21 @@ def barrier(tag: str = "fluxmpi_barrier") -> None:
     Analogue of ``MPI.Barrier`` (reference: src/common.jl:91). Multi-host:
     a global device sync; single-process: drain local async dispatch.
     """
-    t0 = time.perf_counter()
-    flight = _begin_op("barrier", "host", 0)
-    try:
+    def _sync() -> None:
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
 
             multihost_utils.sync_global_devices(tag)
         else:
             jax.effects_barrier()
+
+    if not _instrumentation_on():
+        _sync()
+        return
+    t0 = time.perf_counter()
+    flight = _begin_op("barrier", "host", 0)
+    try:
+        _sync()
     except BaseException:
         _abort_op(flight)
         raise

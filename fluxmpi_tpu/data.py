@@ -20,6 +20,7 @@ analogue in MPI-land.
 from __future__ import annotations
 
 import math
+import os
 import time
 from typing import Any, Iterator, Sequence
 
@@ -40,6 +41,10 @@ __all__ = [
     "DistributedDataLoader",
     "scan_batches",
 ]
+
+# device_gather="auto" staging budget: the replicated stage costs dataset
+# bytes of device memory PER DEVICE, so auto only engages below this.
+_DEVICE_GATHER_DEFAULT_MAX_BYTES = 256 * 1024 * 1024
 
 
 class ArrayDataset:
@@ -197,6 +202,25 @@ class DistributedDataLoader:
         checkpoint resume (``set_epoch``) and draw independently on
         every process. Must preserve each leaf's leading (batch)
         dimension (checked).
+      device_gather: produce batches with a jit-compiled on-device gather
+        instead of host assembly + per-batch transfer. The array-backed
+        dataset is staged into device memory ONCE (replicated per device,
+        cached across epochs), the epoch permutation is transferred once
+        per epoch, and each batch is then one cheap compiled dispatch —
+        a dynamic slice of the permutation + a local gather, with the
+        output already laid out over the data-parallel axis. This removes
+        ALL per-batch host work (no ``np.stack``, no per-leaf
+        ``device_put``), which is what the host pays for today as device
+        counts grow. ``"auto"`` (default) enables it when the dataset is
+        array-backed, single-process, has no ``transform``, and the
+        staged bytes fit the ``FLUXMPI_TPU_DEVICE_GATHER_MAX_BYTES``
+        budget (default 256 MiB — the replicated staging costs dataset
+        bytes of HBM *per device*); ``True`` forces it (raises if the
+        dataset is not array-backed or a ``transform`` is set; falls
+        back to the host path under multi-process, where batch assembly
+        is a cross-process collective); ``False`` keeps the host path.
+        A ragged tail batch (``drop_last=False``) always assembles on
+        the host — a short gather would retrigger XLA compilation.
       transform_with_rng: explicitly declare the transform's call shape:
         ``True`` → ``transform(batch, rng)``, ``False`` →
         ``transform(batch)``. Default ``None`` falls back to, in order:
@@ -234,6 +258,7 @@ class DistributedDataLoader:
         seed: int = 0,
         drop_last: bool = True,
         prefetch: int = 2,
+        device_gather: bool | str = "auto",
         transform: Any = None,
         transform_with_rng: bool | None = None,
     ):
@@ -275,6 +300,30 @@ class DistributedDataLoader:
         if prefetch < 0:
             raise ValueError(f"prefetch must be >= 0, got {prefetch}")
         self.prefetch = prefetch
+        if device_gather not in (True, False, "auto"):
+            raise ValueError(
+                f"device_gather must be True, False, or 'auto', got "
+                f"{device_gather!r}"
+            )
+        if device_gather is True:
+            if transform is not None:
+                raise ValueError(
+                    "device_gather=True is incompatible with transform= "
+                    "(transforms run on host numpy batches); use "
+                    "device_gather=False or 'auto'"
+                )
+            if self._array_backing() is None:
+                raise ValueError(
+                    "device_gather=True requires an array-backed dataset "
+                    "(ArrayDataset, optionally inside a "
+                    "DistributedDataContainer)"
+                )
+        self.device_gather = device_gather
+        # (arrays-object, mesh) -> staged device pytree + compiled gather:
+        # the stage-once half of the device-gather contract. Keyed by
+        # identity so swapping datasets or meshes restages.
+        self._gather_cache: tuple[Any, ...] | None = None
+        self._sharding_cache: tuple[Mesh, NamedSharding] | None = None
         # Host-side augmentation hook — contract in the class docstring.
         self.transform = transform
         if transform is None:
@@ -366,8 +415,16 @@ class DistributedDataLoader:
         self._epoch = int(epoch)
 
     def _sharding(self) -> NamedSharding:
+        # Memoized per (mesh, axis): every batch of every epoch reuses ONE
+        # NamedSharding object — constructing a fresh one per call was
+        # per-batch garbage on the hot path, and a constant object lets
+        # jit-consumers of the batches skip sharding re-hashing.
         mesh = self.mesh or global_mesh()
-        return NamedSharding(mesh, P(self.axis_name))
+        cached = self._sharding_cache
+        if cached is None or cached[0] is not mesh:
+            cached = (mesh, NamedSharding(mesh, P(self.axis_name)))
+            self._sharding_cache = cached
+        return cached[1]
 
     def _array_backing(self) -> tuple[Any, int] | None:
         """If the dataset is array-backed, return (array pytree, index
@@ -380,6 +437,66 @@ class DistributedDataLoader:
             return self.data.data.arrays, self.data.idxs.start
         return None
 
+    def _use_device_gather(self, backing: tuple[Any, int] | None) -> bool:
+        """Resolve the ``device_gather`` spec against this epoch's batch
+        source (policy in the class docstring)."""
+        if self.device_gather is False or backing is None:
+            return False
+        if self.transform is not None:
+            return False
+        if jax.process_count() > 1:
+            # Global batch assembly is a cross-process collective
+            # (make_array_from_process_local_data); the device-gather path
+            # is single-controller. Host path keeps multi-process correct.
+            return False
+        if self.device_gather == "auto":
+            budget = int(
+                os.environ.get(
+                    "FLUXMPI_TPU_DEVICE_GATHER_MAX_BYTES",
+                    str(_DEVICE_GATHER_DEFAULT_MAX_BYTES),
+                )
+            )
+            nbytes = sum(
+                np.asarray(leaf).nbytes
+                for leaf in jax.tree_util.tree_leaves(backing[0])
+            )
+            if nbytes > budget:
+                return False
+        return True
+
+    def _gather_state(self, arrays: Any) -> tuple[Any, Any, Any]:
+        """Stage the backing arrays into device memory (once — cached
+        across epochs) and build the compiled per-batch gather.
+
+        Returns ``(staged pytree, jitted gather, replicated sharding)``.
+        The gather is ``(data, perm, start) -> batch``: a dynamic slice of
+        the epoch permutation plus a local take per leaf, with the output
+        pinned to the loader's batch sharding — ONE compiled dispatch per
+        batch, no retrace across batches or epochs (``start`` is a traced
+        scalar).
+        """
+        mesh = self.mesh or global_mesh()
+        cached = self._gather_cache
+        if cached is not None and cached[0] is arrays and cached[1] is mesh:
+            return cached[2], cached[3], cached[4]
+        replicated = NamedSharding(mesh, P())
+        staged = jax.tree_util.tree_map(
+            lambda a: jax.device_put(np.ascontiguousarray(a), replicated),
+            arrays,
+        )
+        out_sharding = self._sharding()
+        lbs = self.local_batch_size
+
+        def gather(data, perm, start):
+            idx = jax.lax.dynamic_slice_in_dim(perm, start, lbs)
+            return jax.tree_util.tree_map(
+                lambda a: jnp.take(a, idx, axis=0), data
+            )
+
+        fn = jax.jit(gather, out_shardings=out_sharding)
+        self._gather_cache = (arrays, mesh, staged, fn, replicated)
+        return staged, fn, replicated
+
     def _timed_batches(self) -> Iterator[Any]:
         """The batch source with per-batch fetch latency observed into the
         telemetry registry (host assembly + transform + the transfer
@@ -390,7 +507,17 @@ class DistributedDataLoader:
         from .telemetry.watchdog import notify_progress
 
         it = self._iter_batches()
-        hist = _telemetry_registry().histogram("data.batch_fetch_seconds")
+        reg = _telemetry_registry()
+        if not reg.enabled and not _tracing.get_tracer().enabled:
+            # Zero-cost-when-off: no per-batch perf_counter reads or
+            # histogram updates. The watchdog liveness tick stays — it is
+            # one int increment and losing it would blind the stall
+            # detector exactly on the fastest loops.
+            for batch in it:
+                notify_progress()
+                yield batch
+            return
+        hist = reg.histogram("data.batch_fetch_seconds")
         b = 0
         while True:
             t0 = time.perf_counter()
@@ -503,6 +630,37 @@ class DistributedDataLoader:
                     f"dimension; got {after} from {before}"
                 )
             return out
+
+        if backing is not None and self._use_device_gather(backing):
+            # Device-gather fast path: the staged dataset is already in
+            # device memory (cached across epochs), the epoch permutation
+            # transfers once, and each batch is one compiled dispatch —
+            # zero per-batch host work. Indices are global (order + shard
+            # offset) into the staged arrays, same as the native path.
+            arrays, offset = backing
+            staged, gather, replicated = self._gather_state(arrays)
+            lbs = self.local_batch_size
+            full = self._common_len // lbs
+            if full:
+                perm = jax.device_put(
+                    np.asarray(order[: full * lbs], dtype=np.int32)
+                    + np.int32(offset),
+                    replicated,
+                )
+                for b in range(full):
+                    yield gather(staged, perm, np.int32(b * lbs))
+            if nbatches > full:
+                # Ragged tail: a shorter gather would retrace; assemble the
+                # one short batch on the host like the native path does.
+                from .io import gather_rows
+
+                leaves, treedef = jax.tree_util.tree_flatten(arrays)
+                tail = order[full * lbs : self._common_len] + offset
+                batch = jax.tree_util.tree_unflatten(
+                    treedef, [gather_rows(leaf, tail) for leaf in leaves]
+                )
+                yield _globalize(batch)
+            return
 
         if backing is not None:
             # Native fast path: one C++ prefetcher per array leaf assembles

@@ -39,3 +39,4 @@ from .train import (  # noqa: F401
     make_eval_step,
     make_train_step,
 )
+from .loop import train_loop  # noqa: F401  (after .train: loop imports it)

@@ -107,6 +107,17 @@ def shard_batch(
 _DEFAULT_REGISTRY = object()  # sentinel: re-read get_registry() every step
 
 
+def _tag_scan_steps(step: Any, scan_steps: int) -> None:
+    """Record the step's scan width as an attribute so the pipelined
+    driver (:func:`fluxmpi_tpu.parallel.train_loop`) can pick it up
+    without the caller restating it. Best-effort: a jit wrapper that
+    refuses attributes just loses the convenience."""
+    try:
+        step.scan_steps = scan_steps
+    except (AttributeError, TypeError):  # pragma: no cover - jax-version
+        pass
+
+
 def _resolve_metrics(metrics: Any) -> tuple[Any, Any, Any]:
     """Normalize a ``metrics=`` spec to (registry, monitor, hook)."""
     from ..telemetry import MetricsRegistry, TrainingMonitor
@@ -189,6 +200,13 @@ def _instrument_step(compiled, metrics: Any, scan_steps: int):
         return new_state, loss
 
     step.__wrapped__ = compiled  # cost_analysis / AOT access to the jit
+    # Distinct from __wrapped__, which jax.jit ALSO sets (to the raw Python
+    # function) — the loop driver must only unwrap instrumented steps.
+    step.__fluxmpi_compiled__ = compiled
+    # The spec rides along so train_loop can honor it at flush boundaries
+    # after unwrapping the per-step instrumentation.
+    step.__fluxmpi_metrics__ = metrics
+    step.scan_steps = scan_steps  # loop-driver metadata (see parallel.loop)
     return step
 
 
@@ -438,6 +456,7 @@ def make_train_step(
             out_shardings=(state_in, replicated),
             donate_argnums=(0,) if donate else (),
         )
+        _tag_scan_steps(compiled, scan_steps)
         if instrument:
             return _instrument_step(compiled, metrics, scan_steps)
         return compiled
@@ -476,6 +495,7 @@ def make_train_step(
         step_body, mesh, in_specs=(P(), P(name)), out_specs=(P(), P())
     )
     compiled = jax.jit(mapped, donate_argnums=(0,) if donate else ())
+    _tag_scan_steps(compiled, 1)
     if instrument:
         return _instrument_step(compiled, metrics, 1)
     return compiled

@@ -90,10 +90,16 @@ class FlightRecorder:
     """Bounded ring of :class:`FlightEntry` records with a monotonic
     per-process sequence number."""
 
-    def __init__(self, capacity: int = _DEFAULT_CAPACITY):
+    def __init__(self, capacity: int = _DEFAULT_CAPACITY, *, enabled: bool = True):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = capacity
+        # Recording switch. On (the default) a launch costs one count tick
+        # + one deque append; off, begin() returns None and the comm layer's
+        # fast-guard skips the begin/complete pair entirely — the
+        # "zero-cost-when-off" contract shared with the metrics registry
+        # and the tracer.
+        self.enabled = enabled
         self._ring: deque[FlightEntry] = deque(maxlen=capacity)
         # itertools.count.__next__ is atomic in CPython — sequence numbers
         # are unique and totally ordered without a lock. Between taking
@@ -106,8 +112,11 @@ class FlightRecorder:
         self._last_seq = 0
         self._completed = 0
 
-    def begin(self, op: str, path: str, nbytes: int) -> FlightEntry:
-        """Record a launch BEFORE the potentially-blocking call."""
+    def begin(self, op: str, path: str, nbytes: int) -> FlightEntry | None:
+        """Record a launch BEFORE the potentially-blocking call. Returns
+        ``None`` (records nothing) while disabled."""
+        if not self.enabled:
+            return None
         entry = FlightEntry(next(self._count), op, path, nbytes)
         if entry.seq > self._last_seq:
             self._last_seq = entry.seq

@@ -185,6 +185,20 @@ class MetricsRegistry:
         self._kinds: dict[str, type] = {}
         self._sinks: list[Any] = list(sinks)
         self._lock = threading.Lock()
+        # Hot-path switch read by the built-in instrumentation (comm, data
+        # loader): False means "skip recording entirely" — the registry
+        # itself keeps working for direct callers. Hot paths that cache
+        # instrument handles key them on (registry identity, version); see
+        # `version`.
+        self.enabled = True
+        self._version = 0
+
+    @property
+    def version(self) -> int:
+        """Bumped whenever cached instrument handles go stale (currently:
+        on :meth:`reset`, which orphans every existing instrument object).
+        Hot-path handle caches compare this alongside registry identity."""
+        return self._version
 
     # -- instruments --------------------------------------------------
 
@@ -263,10 +277,13 @@ class MetricsRegistry:
         return record
 
     def reset(self) -> None:
-        """Drop all instruments (test isolation helper). Sinks stay."""
+        """Drop all instruments (test isolation helper). Sinks stay. Bumps
+        :attr:`version` so hot-path handle caches re-resolve instead of
+        recording into the orphaned objects."""
         with self._lock:
             self._metrics.clear()
             self._kinds.clear()
+            self._version += 1
 
     def close(self, flush: bool = True) -> None:
         """Close and detach every sink; by default flush a final record

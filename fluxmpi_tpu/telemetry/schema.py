@@ -74,6 +74,12 @@ _BENCH_OPTIONAL: dict[str, tuple[type, ...]] = {
     "attention": (dict,),
     "transformer_lm": (dict,),
     "deq": (dict,),
+    # Steady-state breakdown keys (PR 4): the null-step dispatch floor,
+    # the assembly-only loader sub-rate, and the smoke-mode marker.
+    "dispatch": (dict,),
+    "assembly_samples_per_sec": (int, float),
+    "loader_fed_path": (str,),
+    "smoke": (int,),
 }
 
 

@@ -170,3 +170,129 @@ def test_probe_ladder_outlasts_lease_ttl():
     ladder = sum(bench._DEFAULT_PROBE_TIMEOUTS)
     headline = dict(bench._CONFIGS)["resnet50"]
     assert bench._DEFAULT_BUDGET_S >= ladder + headline + 60
+
+
+def test_leg_breakdown_lifts_diagnostics():
+    rec = {
+        "metric": "mlp_quickstart_samples_per_sec_per_chip",
+        "value": 100.0,
+        "loader_fed_mlp_quickstart_samples_per_sec_per_chip": 80.0,
+        "loader_fed_path": "device_gather",
+        "assembly_samples_per_sec": 900.0,
+        "dispatch": {"per_dispatch_us": 12.5, "n_dev": 8},
+        "scan_steps": 8,
+    }
+    out = bench._leg_breakdown(rec)
+    assert out == {
+        "synthetic": 100.0,
+        "loader_fed": 80.0,
+        "loader_path": "device_gather",
+        "assembly": 900.0,
+        "dispatch_us": 12.5,
+        "scan_steps": 8,
+    }
+    # Minimal record: only the synthetic rate.
+    assert bench._leg_breakdown({"value": 5.0}) == {"synthetic": 5.0}
+
+
+def test_run_scaling_includes_breakdown(monkeypatch):
+    def fake_run_child(config, timeout, platform, extra_env=None):
+        n = extra_env.get("FLUXMPI_TPU_BENCH_DEVICES", "1")
+        return {
+            "metric": "x", "value": 100.0 / int(n), "unit": "u",
+            "vs_baseline": 1.0, "n_chips": int(n),
+            "dispatch": {"per_dispatch_us": 10.0 * int(n), "n_dev": int(n)},
+            "assembly_samples_per_sec": 1000.0,
+        }
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    out = bench._run_scaling(3000.0, None, None)
+    assert set(out["breakdown"]) == {"dp1", "dpN"}
+    assert out["breakdown"]["dp1"]["dispatch_us"] == 10.0
+    assert out["breakdown"]["dpN"]["dispatch_us"] == 80.0
+    assert out["breakdown"]["dpN"]["assembly"] == 1000.0
+
+
+def test_dispatch_probe_on_test_mesh(world):
+    # The null-step probe must produce a sane per-dispatch cost on the
+    # 8-device CPU mesh (the number the scaling breakdown attributes
+    # dispatch overhead with).
+    out = bench._dispatch_probe(world)
+    assert out is not None
+    assert out["n_dev"] == 8
+    assert out["per_dispatch_us"] > 0
+
+
+def test_bench_smoke_mode_emits_schema_valid_json(tmp_path):
+    """The FLUXMPI_TPU_BENCH_SMOKE=1 contract: one real child spawn on
+    CPU with capped steps, stdout JSON + JSONL sink both validating
+    against scripts/check_metrics_schema.py. (The scaling pair is
+    exercised by the slow-marked variant below — this one must stay
+    cheap enough for tier-1.)"""
+    import os
+    import subprocess
+    import sys
+
+    here = os.path.dirname(os.path.abspath(bench.__file__))
+    jsonl = tmp_path / "smoke.jsonl"
+    env = {
+        **os.environ,
+        "FLUXMPI_TPU_BENCH_SMOKE": "1",
+        "FLUXMPI_TPU_BENCH_SMOKE_SCALING": "0",
+        "FLUXMPI_TPU_BENCH_STEPS": "4",
+        "FLUXMPI_TPU_BENCH_MLP_BATCH": "128",
+        "FLUXMPI_TPU_BENCH_JSONL": str(jsonl),
+    }
+    proc = subprocess.run(
+        [sys.executable, os.path.join(here, "bench.py")],
+        capture_output=True, text=True, timeout=240, env=env,
+        cwd=here,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    result = bench._parse_json_line(proc.stdout)
+    assert result is not None and result["metric"] != "bench_failed", (
+        proc.stderr[-2000:]
+    )
+    assert result.get("smoke") == 1
+    assert "dispatch" in result
+    json_path = tmp_path / "smoke.json"
+    json_path.write_text(json.dumps(result))
+    check = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(here, "scripts", "check_metrics_schema.py"),
+            str(json_path),
+            str(jsonl),
+        ],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert check.returncode == 0, check.stdout + check.stderr
+
+
+@pytest.mark.slow
+def test_bench_smoke_mode_full_with_scaling(tmp_path):
+    """Full smoke including the dp1/dpN scaling pair + breakdown."""
+    import os
+    import subprocess
+    import sys
+
+    here = os.path.dirname(os.path.abspath(bench.__file__))
+    env = {
+        **os.environ,
+        "FLUXMPI_TPU_BENCH_SMOKE": "1",
+        "FLUXMPI_TPU_BENCH_STEPS": "4",
+        "FLUXMPI_TPU_BENCH_MLP_BATCH": "128",
+    }
+    proc = subprocess.run(
+        [sys.executable, os.path.join(here, "bench.py")],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=here,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    result = bench._parse_json_line(proc.stdout)
+    assert result is not None
+    scaling = result.get("scaling")
+    assert scaling and "breakdown" in scaling
+    assert scaling["breakdown"]["dpN"]["synthetic"] == scaling[
+        "per_chip_at_dpN"
+    ]

@@ -491,3 +491,187 @@ def test_transform_arity_ignores_defaulted_params(world):
         transform=lambda b: {"x": b, "mean": float(b.mean())})
     with pytest.raises(ValueError, match="leading"):
         next(iter(loader))
+
+
+# ---------------------------------------------------------------------------
+# Steady-state hot path (PR 4): cached batch sharding and the device-side
+# gather fast path.
+# ---------------------------------------------------------------------------
+
+
+def _arrays(n=256, feat=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, feat)).astype(np.float32)
+    y = (np.arange(n) % 7).astype(np.int32)
+    return x, y
+
+
+def test_loader_sharding_is_memoized(world):
+    from fluxmpi_tpu.data import ArrayDataset, DistributedDataLoader
+
+    x, y = _arrays()
+    loader = DistributedDataLoader(ArrayDataset((x, y)), 32, mesh=world)
+    assert loader._sharding() is loader._sharding()
+
+
+def test_loader_batches_carry_constant_sharding_across_epoch(world):
+    # Recompilation guard: every batch of an epoch (and the next epoch)
+    # carries the SAME sharding object, so a jitted consumer never sees a
+    # fresh sharding to re-hash — for both the host and device-gather
+    # paths.
+    from fluxmpi_tpu.data import ArrayDataset, DistributedDataLoader
+
+    x, y = _arrays()
+    for dg in (False, True):
+        loader = DistributedDataLoader(
+            ArrayDataset((x, y)), 32, mesh=world, device_gather=dg
+        )
+        seen = set()
+        for _ in range(2):
+            for bx, _by in loader:
+                seen.add(id(bx.sharding))
+        assert len(seen) == 1, f"device_gather={dg}"
+
+
+def test_device_gather_matches_host_path(world):
+    from fluxmpi_tpu.data import ArrayDataset, DistributedDataLoader
+
+    x, y = _arrays()
+    host = DistributedDataLoader(
+        ArrayDataset((x, y)), 32, mesh=world, device_gather=False,
+        shuffle=True, seed=7,
+    )
+    dev = DistributedDataLoader(
+        ArrayDataset((x, y)), 32, mesh=world, device_gather=True,
+        shuffle=True, seed=7,
+    )
+    hb, db = list(host), list(dev)
+    assert len(hb) == len(db) == 8
+    for (hx, hy), (dx, dy) in zip(hb, db):
+        np.testing.assert_array_equal(np.asarray(hx), np.asarray(dx))
+        np.testing.assert_array_equal(np.asarray(hy), np.asarray(dy))
+        assert dx.sharding.is_equivalent_to(hx.sharding, dx.ndim)
+
+
+def test_device_gather_stages_once_and_never_retraces(world):
+    from fluxmpi_tpu.data import ArrayDataset, DistributedDataLoader
+
+    x, y = _arrays()
+    loader = DistributedDataLoader(
+        ArrayDataset((x, y)), 32, mesh=world, device_gather=True,
+        shuffle=True,
+    )
+    for _ in loader:
+        pass
+    cache1 = loader._gather_cache
+    assert cache1 is not None
+    for _ in loader:  # second epoch: new permutation, same staging
+        pass
+    assert loader._gather_cache is cache1
+    gather_fn = cache1[3]
+    # One trace covers every batch of every epoch (start is a traced
+    # scalar, the permutation a same-shape array).
+    assert gather_fn._cache_size() == 1
+
+
+def test_device_gather_ragged_tail_and_container(world):
+    from fluxmpi_tpu.data import (
+        ArrayDataset,
+        DistributedDataContainer,
+        DistributedDataLoader,
+    )
+
+    x, y = _arrays(104)
+    ds = DistributedDataContainer(ArrayDataset((x, y)))
+    loader = DistributedDataLoader(
+        ds, 24, mesh=world, device_gather=True, drop_last=False
+    )
+    sizes = [np.asarray(bx).shape[0] for bx, _ in loader]
+    assert sizes == [24, 24, 24, 24, 8]
+    # Content parity with the host path, tail included.
+    host = DistributedDataLoader(
+        DistributedDataContainer(ArrayDataset((x, y))), 24, mesh=world,
+        device_gather=False, drop_last=False,
+    )
+    for (hx, hy), (dx, dy) in zip(host, loader):
+        np.testing.assert_array_equal(np.asarray(hx), np.asarray(dx))
+        np.testing.assert_array_equal(np.asarray(hy), np.asarray(dy))
+
+
+def test_device_gather_validation_and_auto_fallbacks(world, monkeypatch):
+    from fluxmpi_tpu.data import ArrayDataset, DistributedDataLoader
+
+    x, y = _arrays()
+    with pytest.raises(ValueError, match="device_gather"):
+        DistributedDataLoader(
+            ArrayDataset((x, y)), 32, mesh=world, device_gather="yes"
+        )
+    # True + transform: transforms are host-side — loud error, not a
+    # silent fallback.
+    with pytest.raises(ValueError, match="transform"):
+        DistributedDataLoader(
+            ArrayDataset((x, y)), 32, mesh=world, device_gather=True,
+            transform=lambda b: b,
+        )
+    # True + non-array dataset: nothing to stage.
+    with pytest.raises(ValueError, match="array-backed"):
+        DistributedDataLoader(
+            [(x[i], y[i]) for i in range(len(x))], 32, mesh=world,
+            device_gather=True,
+        )
+    # auto + transform silently keeps the host path.
+    loader = DistributedDataLoader(
+        ArrayDataset((x, y)), 32, mesh=world,
+        transform=lambda b: b,
+    )
+    assert not loader._use_device_gather(loader._array_backing())
+    # auto respects the staging byte budget.
+    loader2 = DistributedDataLoader(ArrayDataset((x, y)), 32, mesh=world)
+    assert loader2._use_device_gather(loader2._array_backing())
+    monkeypatch.setenv("FLUXMPI_TPU_DEVICE_GATHER_MAX_BYTES", "16")
+    assert not loader2._use_device_gather(loader2._array_backing())
+
+
+def test_device_gather_global_shuffle_epoch_disjoint(world):
+    # global_shuffle must see every sample exactly once per epoch through
+    # the device path too.
+    from fluxmpi_tpu.data import (
+        ArrayDataset,
+        DistributedDataContainer,
+        DistributedDataLoader,
+    )
+
+    x = np.arange(128, dtype=np.float32)[:, None]
+    y = np.arange(128, dtype=np.int32)
+    loader = DistributedDataLoader(
+        DistributedDataContainer(ArrayDataset((x, y))), 32, mesh=world,
+        device_gather=True, global_shuffle=True, seed=11,
+    )
+    seen = np.concatenate([np.asarray(by) for _, by in loader])
+    assert sorted(seen.tolist()) == list(range(128))
+
+
+def test_loader_skips_fetch_timing_when_telemetry_off(world):
+    # Zero-cost-when-off on the data hot path: with the registry and
+    # tracer disabled no fetch histogram is touched; the watchdog tick
+    # stays.
+    from fluxmpi_tpu.data import ArrayDataset, DistributedDataLoader
+    from fluxmpi_tpu.telemetry import get_registry, watchdog
+
+    x, y = _arrays()
+    loader = DistributedDataLoader(ArrayDataset((x, y)), 32, mesh=world)
+    reg = get_registry()
+    hist = reg.histogram("data.batch_fetch_seconds")
+    n0 = hist.count
+    p0 = watchdog._progress_value()
+    reg.enabled = False
+    try:
+        for _ in loader:
+            pass
+    finally:
+        reg.enabled = True
+    assert hist.count == n0
+    assert watchdog._progress_value() >= p0 + 8
+    for _ in loader:  # re-enabled: timing resumes
+        pass
+    assert hist.count == n0 + 8
