@@ -1224,19 +1224,87 @@ def _leg_breakdown(rec: dict) -> dict:
     return out
 
 
+def _bench_result_key(bench: dict) -> tuple:
+    """Identity of a bench configuration inside the shared JSONL stream:
+    re-running the same config REPLACES its line instead of appending a
+    duplicate, so an interrupted sweep accumulates one line per config
+    across restarts (restart-proof result banking, VERDICT r5 top-next)."""
+    return (
+        bench.get("metric"),
+        # Failure records carry no device_kind/n_chips — the config name
+        # keeps failures from different benches on distinct lines.
+        bench.get("config"),
+        bench.get("platform"),
+        bench.get("device_kind"),
+        bench.get("n_chips"),
+        bench.get("scan_steps"),
+        bench.get("smoke"),
+    )
+
+
+def _merge_bench_jsonl(path: str, record: dict) -> None:
+    """Merge one flush record into the JSONL file keyed by bench config:
+    non-bench lines and other configs are preserved verbatim, the
+    matching config's line is replaced, new configs append. Written
+    tmp-then-rename so a crash mid-merge never truncates banked results
+    (the checkpoint commit discipline, docs/fault_tolerance.md).
+    All writers sharing one JSONL serialize on the ``<path>.lock``
+    sidecar (:func:`fluxmpi_tpu.telemetry.sinks.jsonl_lock` — the
+    per-line sink appenders take the same lock), so the
+    read-merge-replace never drops a line another writer lands
+    mid-merge. Note the replace swaps the inode: follow with ``tail
+    -F`` (not ``-f``)."""
+    from fluxmpi_tpu.telemetry.sinks import jsonl_lock
+
+    with jsonl_lock(path):
+        _merge_bench_jsonl_locked(path, record)
+
+
+def _merge_bench_jsonl_locked(path: str, record: dict) -> None:
+    key = _bench_result_key(record["bench"])
+    lines: list[str] = []
+    if os.path.exists(path):
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if not line.strip():
+                    continue
+                try:
+                    old = json.loads(line)
+                except json.JSONDecodeError:
+                    lines.append(line)  # never drop someone else's data
+                    continue
+                if (
+                    isinstance(old, dict)
+                    and isinstance(old.get("bench"), dict)
+                    and _bench_result_key(old["bench"]) == key
+                ):
+                    continue  # superseded by this run
+                lines.append(line)
+    lines.append(json.dumps(record))
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write("\n".join(lines) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
 def _emit_telemetry(result: dict) -> None:
-    """Mirror the headline result through the telemetry sink layer (one
+    """Mirror the headline result through the telemetry record layer (one
     JSONL line, fluxmpi_tpu.telemetry schema) when FLUXMPI_TPU_BENCH_JSONL
     is set. The stdout JSON contract is untouched — this is the same
-    record riding the same pipe every other metric in the system uses, so
-    one tail/validator covers training runs and bench runs alike."""
+    record shape riding the same pipe every other metric in the system
+    uses, so one tail/validator covers training runs and bench runs
+    alike. Lines are MERGED keyed by config (see _bench_result_key), not
+    appended: an interrupted sweep re-run banks each config once."""
     path = os.environ.get("FLUXMPI_TPU_BENCH_JSONL")
     if not path:
         return
     try:
-        from fluxmpi_tpu.telemetry import JSONLSink, MetricsRegistry
+        from fluxmpi_tpu.telemetry import MetricsRegistry
 
-        reg = MetricsRegistry(sinks=[JSONLSink(path)])
+        reg = MetricsRegistry()
         labels = {
             k: str(result[k])
             for k in ("platform", "device_kind")
@@ -1254,8 +1322,9 @@ def _emit_telemetry(result: dict) -> None:
             )
         # The full result rides along so the JSONL line alone reconstructs
         # the run (validated as a bench record by check_metrics_schema).
-        reg.flush(bench=result)
+        record = reg.flush(bench=result)
         reg.close(flush=False)
+        _merge_bench_jsonl(path, record)
     except Exception as exc:  # emission must never sink the bench run
         print(f"bench: telemetry emit failed: {exc!r}", file=sys.stderr)
 
@@ -1274,7 +1343,7 @@ def _run_smoke(remaining) -> None:
     result = _run_child("mlp", 240.0, "cpu")
     if result is None:
         result = {"metric": "bench_failed", "value": 0.0, "unit": "none",
-                  "vs_baseline": 0.0}
+                  "vs_baseline": 0.0, "config": "mlp", "platform": "cpu"}
     # Marked on failures too: a CI smoke crash must never read as a real
     # benchmark round in the shared JSONL trajectory.
     result["smoke"] = 1
@@ -1317,8 +1386,13 @@ def main() -> None:
         }.get(forced, 300.0)
         result = _run_child(forced, child_to, platform)
         if result is None:
+            # The failed config (and attempted platform) ride the record:
+            # they are part of the JSONL merge key, so failures from
+            # different configs bank as distinct lines instead of
+            # silently replacing each other.
             result = {"metric": "bench_failed", "value": 0.0,
-                      "unit": "none", "vs_baseline": 0.0}
+                      "unit": "none", "vs_baseline": 0.0, "config": forced,
+                      **({"platform": platform} if platform else {})}
         _emit_telemetry(result)
         print(json.dumps(result))
         return
@@ -1373,8 +1447,11 @@ def main() -> None:
             break
 
     if result is None:
+        # `config` is the last plan entry attempted — names which bench
+        # the failure line belongs to in the JSONL bank.
         result = {"metric": "bench_failed", "value": 0.0, "unit": "none",
-                  "vs_baseline": 0.0}
+                  "vs_baseline": 0.0, "config": config,
+                  **({"platform": child_platform} if child_platform else {})}
     result["probe"] = {"attempts": probe_attempts}
 
     # Phase 3: secondary metrics, budget permitting — never at the expense

@@ -21,24 +21,40 @@ Public surface (parity with reference exports, src/FluxMPI.jl:88-96):
   :class:`~fluxmpi_tpu.telemetry.TrainingMonitor`, span tracing, the
   collective flight recorder, and the hang watchdog — no reference
   analogue; see docs/observability.md)
+- fault tolerance: :mod:`fluxmpi_tpu.faults` (deterministic fault
+  injection), preemption handling (:func:`preemption_requested` and
+  friends), and crash-consistent checkpointing in
+  :mod:`fluxmpi_tpu.utils.checkpoint` — no reference analogue; see
+  docs/fault_tolerance.md
 """
 
 from . import config  # noqa: F401
 from . import telemetry  # noqa: F401
-from .errors import FluxMPINotInitializedError  # noqa: F401
+from . import faults  # noqa: F401
+from .errors import (  # noqa: F401
+    CheckpointDesyncError,
+    CheckpointTimeoutError,
+    FaultInjectedError,
+    FluxMPINotInitializedError,
+)
 from .runtime import (  # noqa: F401
     Initialized,
+    clear_preemption,
     device_count,
     dp_axis_name,
     global_mesh,
     init,
+    install_preemption_handlers,
     is_initialized,
     local_device_count,
     local_rank,
+    preemption_requested,
     process_count,
     process_index,
+    request_preemption,
     shutdown,
     total_workers,
+    uninstall_preemption_handlers,
 )
 from .logging import fluxmpi_print, fluxmpi_println  # noqa: F401
 from .comm import (  # noqa: F401

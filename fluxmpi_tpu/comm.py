@@ -39,6 +39,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import config
+from . import faults as _faults
 from .runtime import global_mesh
 from .telemetry import get_registry as _telemetry_registry
 from .telemetry import tracing as _tracing
@@ -339,6 +340,12 @@ def _run_collective(
     axis_name: str | None = None,
     donate: bool = False,
 ) -> jax.Array:
+    # Chaos hook first (one attribute read when disarmed — the same
+    # zero-cost-when-off contract as the instrumentation guard below):
+    # an injected collective failure fires before any staging, like a
+    # transport error would.
+    if _faults.ARMED:
+        _faults.check("comm." + kind)
     # One cheap guard up front: the fully-off path (no telemetry, no
     # flight recorder, no tracing) must do no timing and no dict work.
     instrumented = _instrumentation_on()
@@ -548,6 +555,8 @@ def barrier(tag: str = "fluxmpi_barrier") -> None:
         else:
             jax.effects_barrier()
 
+    if _faults.ARMED:
+        _faults.check("comm.barrier")
     if not _instrumentation_on():
         _sync()
         return
@@ -570,6 +579,8 @@ def barrier(tag: str = "fluxmpi_barrier") -> None:
 
 def host_allreduce(x: Any, op: str = "sum") -> np.ndarray:
     """Reduce a per-process host value across all controller processes."""
+    if _faults.ARMED:
+        _faults.check("comm.host_allreduce")
     op = _canonical_op(op)
     t0 = time.perf_counter()
     h = np.asarray(x)
@@ -598,6 +609,8 @@ def host_allgather(x: Any) -> np.ndarray:
     per-host picture — min/max/mean/outliers are then local math, which
     is why the :class:`~fluxmpi_tpu.telemetry.TrainingMonitor` uses this
     instead of one :func:`host_allreduce` per statistic."""
+    if _faults.ARMED:
+        _faults.check("comm.host_allgather")
     t0 = time.perf_counter()
     h = np.asarray(x)
     flight = _begin_op("host_allgather", "host", h.nbytes)
@@ -618,6 +631,8 @@ def host_allgather(x: Any) -> np.ndarray:
 
 def host_bcast(x: Any, root: int = 0) -> np.ndarray:
     """Broadcast a per-process host value from the root process to all."""
+    if _faults.ARMED:
+        _faults.check("comm.host_bcast")
     t0 = time.perf_counter()
     h = np.asarray(x)
     flight = _begin_op("host_bcast", "host", h.nbytes)

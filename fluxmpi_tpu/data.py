@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import config
+from . import faults as _faults
 from .runtime import global_mesh
 from .telemetry import get_registry as _telemetry_registry
 from .telemetry import tracing as _tracing
@@ -370,6 +371,13 @@ class DistributedDataLoader:
                     required = 1
                 self._transform_arity = 2 if required >= 2 else 1
         self._epoch = 0
+        # Resumable-iteration state (state_dict/load_state_dict): the
+        # epoch whose permutation the current pass uses, the number of
+        # batches handed to the consumer this pass, and a pending
+        # mid-epoch start position installed by load_state_dict.
+        self._iter_epoch = 0
+        self._cursor = 0
+        self._resume_cursor = 0
         # Per-process shard sizes can differ (ceil partition, remainder on
         # the last rank). jax.make_array_from_process_local_data is a
         # cross-process collective, so every process MUST yield the same
@@ -411,8 +419,63 @@ class DistributedDataLoader:
         global-shuffle worker assignment). Call after restoring a
         checkpoint so a resumed run draws the same sample order the
         uninterrupted run would have — the loader's counter is plain
-        Python state and is NOT part of the checkpointed TrainState."""
+        Python state and is NOT part of the checkpointed TrainState.
+        For mid-epoch-exact resume use
+        :meth:`state_dict`/:meth:`load_state_dict` instead."""
         self._epoch = int(epoch)
+        self._iter_epoch = int(epoch)
+        self._cursor = 0
+        self._resume_cursor = 0
+
+    def state_dict(self) -> dict[str, int]:
+        """Iteration position as plain ints: the ``epoch`` whose
+        permutation the current pass uses, the ``cursor`` of batches
+        already handed to the consumer this pass, and the shuffle
+        ``seed`` (restore-time validation). Captured at a batch boundary
+        this is exactly "everything up to and including batch ``cursor``
+        was consumed" — internal prefetch/read-ahead never counts, so
+        the checkpointed position matches what the training loop
+        actually dispatched (see docs/fault_tolerance.md)."""
+        return {
+            "epoch": self._iter_epoch,
+            "cursor": self._cursor,
+            "seed": self.seed,
+        }
+
+    def load_state_dict(self, state: dict[str, Any]) -> None:
+        """Restore a :meth:`state_dict`: the next ``iter()`` replays
+        ``epoch``'s permutation starting at batch ``cursor`` —
+        mid-epoch-exact on the host, native, and device-gather paths
+        (skipped batches are index arithmetic, nothing is fetched). A
+        cursor at the end of the epoch resumes at the next epoch's
+        first batch."""
+        seed = int(state.get("seed", self.seed))
+        if seed != self.seed:
+            raise ValueError(
+                f"loader state was captured with seed {seed} but this "
+                f"loader uses seed {self.seed}: the resumed sample order "
+                f"would silently diverge from the interrupted run"
+            )
+        epoch = int(state["epoch"])
+        cursor = int(state["cursor"])
+        if cursor < 0 or cursor > len(self):
+            raise ValueError(
+                f"cursor {cursor} out of range for a {len(self)}-batch epoch"
+            )
+        if cursor >= len(self):  # epoch fully consumed: resume at the next
+            epoch, cursor = epoch + 1, 0
+        self._epoch = epoch
+        self._iter_epoch = epoch
+        self._cursor = cursor
+        self._resume_cursor = cursor
+
+    @property
+    def resume_cursor(self) -> int:
+        """Batches of the restored pass the next ``iter()`` will skip —
+        the normalized mid-epoch position :meth:`load_state_dict` set
+        (0 when none pending). Consumers (``train_loop``) read this to
+        seat their own per-pass accounting after a resume."""
+        return self._resume_cursor
 
     def _sharding(self) -> NamedSharding:
         # Memoized per (mesh, axis): every batch of every epoch reuses ONE
@@ -512,19 +575,37 @@ class DistributedDataLoader:
             # Zero-cost-when-off: no per-batch perf_counter reads or
             # histogram updates. The watchdog liveness tick stays — it is
             # one int increment and losing it would blind the stall
-            # detector exactly on the fastest loops.
-            for batch in it:
+            # detector exactly on the fastest loops. The chaos hook is
+            # the same one-attribute-read guard as the comm layer.
+            while True:
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
+                if _faults.ARMED:
+                    # AFTER the fetch so hit N maps to real batch N —
+                    # the end-of-epoch StopIteration probe never counts
+                    # (a step= schedule would otherwise drift one hit
+                    # per epoch).
+                    _faults.check("data.fetch")
                 notify_progress()
                 yield batch
-            return
         hist = reg.histogram("data.batch_fetch_seconds")
-        b = 0
+        # Key trace events by the ABSOLUTE batch position in the epoch
+        # permutation: on a resumed pass the first fetched batch is batch
+        # `resume_cursor`, not 0 (read here, before _iter_batches' first
+        # next() consumes the pending cursor), so the resumed run's
+        # data.fetch timeline lines up batch-for-batch with the
+        # uninterrupted run it must reproduce.
+        b = self._resume_cursor
         while True:
             t0 = time.perf_counter()
             try:
                 batch = next(it)
             except StopIteration:
                 return
+            if _faults.ARMED:
+                _faults.check("data.fetch")  # post-fetch: hit N == batch N
             t1 = time.perf_counter()
             hist.observe(t1 - t0)
             _tracing.add_complete_event("data.fetch", t0, t1, batch=b)
@@ -539,9 +620,15 @@ class DistributedDataLoader:
     def __iter__(self) -> Iterator[Any]:
         it = self._timed_batches()
         depth = _telemetry_registry().gauge("data.prefetch_depth")
+        # `_cursor` counts batches HANDED TO THE CONSUMER — incremented at
+        # the yield, never when the prefetcher reads ahead — so a
+        # state_dict() taken at a batch boundary names exactly the batches
+        # the training loop consumed (the resume contract).
         if not self.prefetch:
             depth.set(0)
-            yield from it
+            for batch in it:
+                self._cursor += 1
+                yield batch
             return
         # Device-side prefetch (flax prefetch_to_device shape, mesh-sharded):
         # run the batch source ahead of the consumer so each global batch's
@@ -555,9 +642,11 @@ class DistributedDataLoader:
             queue.append(batch)
             if len(queue) > self.prefetch:
                 depth.set(len(queue) - 1)
+                self._cursor += 1
                 yield queue.popleft()
         while queue:
             depth.set(len(queue) - 1)
+            self._cursor += 1
             yield queue.popleft()
 
     def _iter_batches(self) -> Iterator[Any]:
@@ -590,6 +679,17 @@ class DistributedDataLoader:
         epoch_now = self._epoch  # the epoch the shuffle rngs above used
         self._epoch += 1
         sharding = self._sharding()
+
+        # Mid-epoch resume (load_state_dict): start this pass at batch
+        # `start` of the epoch permutation. Skipping is index arithmetic
+        # on `order` — the skipped batches are never fetched — and the
+        # transform rng / trace batch index stay keyed by the ABSOLUTE
+        # batch position, so a resumed pass reproduces the uninterrupted
+        # pass exactly on every path.
+        start = self._resume_cursor
+        self._resume_cursor = 0
+        self._iter_epoch = epoch_now
+        self._cursor = start
 
         nbatches = len(self)
 
@@ -641,13 +741,13 @@ class DistributedDataLoader:
             staged, gather, replicated = self._gather_state(arrays)
             lbs = self.local_batch_size
             full = self._common_len // lbs
-            if full:
+            if full > start:
                 perm = jax.device_put(
                     np.asarray(order[: full * lbs], dtype=np.int32)
                     + np.int32(offset),
                     replicated,
                 )
-                for b in range(full):
+                for b in range(start, full):
                     yield gather(staged, perm, np.int32(b * lbs))
             if nbatches > full:
                 # Ragged tail: a shorter gather would retrace; assemble the
@@ -674,8 +774,8 @@ class DistributedDataLoader:
             lbs = self.local_batch_size
             full = self._common_len // lbs
             leaves, treedef = jax.tree_util.tree_flatten(arrays)
-            if full:
-                epoch_order = order[: full * lbs] + offset
+            if full > start:
+                epoch_order = order[start * lbs : full * lbs] + offset
                 prefetchers = [
                     iter(NativePrefetcher(leaf, epoch_order, lbs))
                     for leaf in leaves
@@ -684,7 +784,7 @@ class DistributedDataLoader:
                     batch = jax.tree_util.tree_unflatten(
                         treedef, list(leaf_batches)
                     )
-                    yield _globalize(_transformed(batch, b))
+                    yield _globalize(_transformed(batch, start + b))
             if nbatches > full:
                 tail = order[full * lbs : self._common_len] + offset
                 batch = jax.tree_util.tree_unflatten(
@@ -693,7 +793,7 @@ class DistributedDataLoader:
                 yield _globalize(_transformed(batch, full))
             return
 
-        for b in range(nbatches):
+        for b in range(start, nbatches):
             # Cap at _common_len so every process yields the same local batch
             # size even when shard lengths differ (the ragged tail under
             # drop_last=False) — mismatched local sizes would break the

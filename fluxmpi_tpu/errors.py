@@ -26,3 +26,34 @@ class FluxMPINotInitializedError(RuntimeError):
 
 class CollectiveError(RuntimeError):
     """Raised when an eager collective cannot be lowered or executed."""
+
+
+class FaultInjectedError(RuntimeError):
+    """Raised by :mod:`fluxmpi_tpu.faults` when an armed fault schedule
+    fires at a named site — the synthetic analogue of a transient I/O
+    error, a dropped collective, or a killed fetch. Retry layers that
+    tolerate real transient failures (checkpoint writes) treat it exactly
+    like an ``OSError`` so chaos tests exercise the production path."""
+
+    def __init__(self, site: str, hit: int, spec: str = "") -> None:
+        self.site = site
+        self.hit = hit
+        super().__init__(
+            f"fault injected at site {site!r} (hit {hit})"
+            + (f" by schedule entry {spec!r}" if spec else "")
+        )
+
+
+class CheckpointTimeoutError(RuntimeError):
+    """Raised when a checkpoint save/wait exceeds the hard deadline set by
+    ``FLUXMPI_TPU_CKPT_TIMEOUT`` — a background save wedged past the point
+    where periodic warnings are useful (one process missing a
+    cross-process barrier cannot be waited out)."""
+
+
+class CheckpointDesyncError(RuntimeError):
+    """Raised when processes disagree on the step number being
+    checkpointed: banking the save would mix states from different steps
+    into one "checkpoint". The save is aborted and the collective
+    flight-recorder tail is dumped next to the checkpoint directory so the
+    desync point can be localized (see docs/fault_tolerance.md)."""

@@ -1,0 +1,354 @@
+"""Deterministic fault injection: chaos testing with named sites.
+
+The recovery machinery of this repo (checkpoint retries, preemption
+drains, resume) only earns trust if the failures it guards against can
+be produced on demand, reproducibly, in tier-1 tests with no sleeps and
+no real I/O errors. This module is that producer: a schedule of
+:class:`FaultSpec` entries armed against **named sites** woven into the
+hot paths —
+
+====================  =====================================================
+site                  where it fires
+====================  =====================================================
+``comm.allreduce``    :func:`fluxmpi_tpu.comm.allreduce` (entry, pre-stage)
+``comm.bcast``        :func:`fluxmpi_tpu.comm.bcast`
+``comm.reduce``       :func:`fluxmpi_tpu.comm.reduce`
+``comm.barrier``      :func:`fluxmpi_tpu.comm.barrier`
+``comm.host_*``       the host-level cross-process collectives
+``data.fetch``        each :class:`~fluxmpi_tpu.data.DistributedDataLoader`
+                      batch fetch (prefetcher-side, i.e. where real fetch
+                      failures happen)
+``ckpt.write``        each checkpoint write **attempt** (inside the retry
+                      loop — ``times=2`` exercises two retries then
+                      success)
+``ckpt.commit``       between the checkpoint rename and the COMMIT marker
+                      (simulates a crash that leaves an uncommitted step)
+``ckpt.read``         :func:`~fluxmpi_tpu.utils.checkpoint.restore_checkpoint`
+====================  =====================================================
+
+A firing site raises :class:`FaultInjectedError` (re-exported from
+:mod:`fluxmpi_tpu.errors`), bumps the ``fault.injected`` counter
+(labeled by site) in the default telemetry registry, and lands a
+``fault.injected`` instant on the trace timeline when tracing is on.
+
+**Schedule grammar** — set via :func:`install` / :func:`configure` or the
+``FLUXMPI_TPU_FAULTS`` env var; comma-separated entries::
+
+    entry := site[@step=N][:key=value]*
+    keys  := step   fire at the Nth hit of the site (1-based; ``@step=N``
+                    is sugar for ``:step=N``)
+             p      fire each hit with probability p (seeded — see seed)
+             seed   RNG seed for ``p`` draws (default 0; the per-process
+                    stream is seeded (seed, process_index) so processes
+                    draw independently but reproducibly)
+             times  cap on total injections for this entry (default 1 for
+                    step/bare entries, unlimited for ``p`` entries)
+             proc   only fire on this controller-process index
+
+Examples: ``comm.allreduce@step=7`` (the 7th allreduce raises, once),
+``ckpt.write:p=0.1:seed=0`` (each write attempt fails with p=0.1),
+``data.fetch@step=5:times=2:proc=1`` (process 1's 5th and 6th fetches).
+
+**Determinism**: every site keeps a monotonic hit counter; ``step``
+entries key off it, ``p`` entries draw one value from a seeded
+per-process ``np.random.Generator`` per eligible hit. Same schedule +
+same execution ⇒ same injections. :func:`clear` resets both schedule
+and counters.
+
+**Zero-cost when off** (the PR-4 fast-guard contract): call sites guard
+on the module attribute :data:`ARMED` — one attribute read — and only
+enter :func:`check` when a schedule is installed. With nothing armed a
+collective/fetch/checkpoint pays no string building, no dict lookups,
+no RNG draws (unit-tested by monkeypatching :func:`check` to explode).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Iterable
+
+import numpy as np
+
+from .errors import FaultInjectedError
+from .telemetry import get_registry as _telemetry_registry
+from .telemetry import tracing as _tracing
+from .telemetry.registry import process_index_or_zero as _process_index
+
+__all__ = [
+    "FaultInjectedError",
+    "FaultSpec",
+    "ARMED",
+    "install",
+    "clear",
+    "configure",
+    "check",
+    "scope",
+    "active",
+    "injected_count",
+]
+
+_ENV_VAR = "FLUXMPI_TPU_FAULTS"
+
+# The fast-guard: True iff a schedule is installed. Woven sites read this
+# ONE module attribute before doing anything else; everything below this
+# line is off the hot path.
+ARMED = False
+
+
+class FaultSpec:
+    """One schedule entry: a site plus its firing condition (grammar in
+    the module docstring). Instances carry their own injection count and
+    RNG stream, so a schedule is reproducible state, not configuration."""
+
+    def __init__(
+        self,
+        site: str,
+        *,
+        step: int | None = None,
+        p: float | None = None,
+        seed: int = 0,
+        times: int | None = None,
+        proc: int | None = None,
+    ):
+        if not site or not isinstance(site, str):
+            raise ValueError(f"fault site must be a non-empty string, got {site!r}")
+        if step is not None and step < 1:
+            raise ValueError(f"step must be >= 1 (1-based hit index), got {step}")
+        if p is not None and not 0.0 <= p <= 1.0:
+            raise ValueError(f"p must be in [0, 1], got {p}")
+        if step is not None and p is not None:
+            raise ValueError("step= and p= are mutually exclusive triggers")
+        if times is not None and times < 1:
+            raise ValueError(f"times must be >= 1, got {times}")
+        self.site = site
+        self.step = step
+        self.p = p
+        self.seed = int(seed)
+        # Bare/step entries default to a single injection (a "crash");
+        # probability entries default to unlimited (a flaky medium).
+        self.times = times if times is not None else (None if p is not None else 1)
+        self.proc = proc
+        self.injected = 0
+        self._rng = (
+            np.random.default_rng([self.seed, _process_index()])
+            if p is not None
+            else None
+        )
+
+    def should_fire(self, hit: int) -> bool:
+        if self.proc is not None and _process_index() != self.proc:
+            return False
+        if self.times is not None and self.injected >= self.times:
+            return False
+        if self.step is not None:
+            return hit >= self.step
+        if self.p is not None:
+            return float(self._rng.random()) < self.p
+        return True
+
+    def __str__(self) -> str:
+        parts = [self.site]
+        if self.step is not None:
+            parts.append(f"step={self.step}")
+        if self.p is not None:
+            parts.append(f"p={self.p}")
+            parts.append(f"seed={self.seed}")
+        if self.times is not None:
+            parts.append(f"times={self.times}")
+        if self.proc is not None:
+            parts.append(f"proc={self.proc}")
+        return ":".join(parts)
+
+    __repr__ = __str__
+
+
+def parse_spec(entry: str) -> FaultSpec:
+    """Parse one schedule entry (``site[@step=N][:key=value]*``)."""
+    entry = entry.strip()
+    if not entry:
+        raise ValueError("empty fault schedule entry")
+    head, _, rest = entry.partition(":")
+    site, _, at = head.partition("@")
+    kwargs: dict[str, Any] = {}
+    tokens = ([at] if at else []) + ([t for t in rest.split(":") if t] if rest else [])
+    for tok in tokens:
+        key, eq, value = tok.partition("=")
+        if not eq:
+            raise ValueError(
+                f"bad fault modifier {tok!r} in {entry!r}: expected key=value"
+            )
+        key = key.strip()
+        if key in ("step", "times", "proc", "seed"):
+            kwargs[key] = int(value)
+        elif key == "p":
+            kwargs[key] = float(value)
+        else:
+            raise ValueError(
+                f"unknown fault modifier {key!r} in {entry!r}; expected one "
+                f"of step/p/seed/times/proc"
+            )
+    return FaultSpec(site.strip(), **kwargs)
+
+
+class _Schedule:
+    """Installed specs grouped by site, plus the per-site hit counters."""
+
+    def __init__(self, specs: list[FaultSpec]):
+        self.specs = specs
+        self.by_site: dict[str, list[FaultSpec]] = {}
+        for s in specs:
+            self.by_site.setdefault(s.site, []).append(s)
+        self.hits: dict[str, int] = {}
+        self.injected = 0
+
+
+_active: _Schedule | None = None
+_configured_spec: str | None = None  # string spec the schedule came from
+
+
+def _coerce(spec: Any) -> list[FaultSpec]:
+    if isinstance(spec, FaultSpec):
+        return [spec]
+    if isinstance(spec, str):
+        return [parse_spec(e) for e in spec.split(",") if e.strip()]
+    if isinstance(spec, Iterable):
+        out: list[FaultSpec] = []
+        for s in spec:
+            out.extend(_coerce(s))
+        return out
+    raise ValueError(
+        f"fault schedule must be a spec string, a FaultSpec, or an "
+        f"iterable of those; got {spec!r}"
+    )
+
+
+def install(spec: Any, *, append: bool = False) -> list[FaultSpec]:
+    """Arm a fault schedule (replacing any current one unless ``append``).
+    Accepts the grammar string, a :class:`FaultSpec`, or a list; returns
+    the installed specs. Hit counters reset on replace, persist on append
+    (an appended entry sees the site's full history)."""
+    global _active, ARMED, _configured_spec
+    specs = _coerce(spec)
+    _configured_spec = None  # direct installs supersede configure()'s
+    if append and _active is not None:
+        merged = _Schedule(_active.specs + specs)
+        merged.hits = _active.hits
+        merged.injected = _active.injected
+        _active = merged
+    else:
+        _active = _Schedule(specs) if specs else None
+    ARMED = _active is not None
+    return specs
+
+
+def clear() -> None:
+    """Disarm: drop the schedule and every hit counter (idempotent)."""
+    global _active, ARMED, _configured_spec
+    _active = None
+    ARMED = False
+    _configured_spec = None
+
+
+def active() -> list[FaultSpec]:
+    """The armed specs (empty when off)."""
+    return list(_active.specs) if _active is not None else []
+
+
+def injected_count() -> int:
+    """Total injections fired by the current schedule."""
+    return _active.injected if _active is not None else 0
+
+
+def configure(spec: Any = None) -> list[FaultSpec]:
+    """Wire the schedule from a one-value spec (the
+    :func:`fluxmpi_tpu.telemetry.configure` shape):
+
+    - ``None`` — read ``FLUXMPI_TPU_FAULTS`` (no-op when unset/empty);
+    - ``False`` / ``""`` / ``"0"`` — disarm;
+    - a grammar string / :class:`FaultSpec` / list — install it.
+
+    Called by ``fluxmpi_tpu.init(faults=...)``, including on idempotent
+    replays — a replay that finds the SAME string schedule (env-sourced
+    or explicit ``faults=``) already armed is a no-op, so hit counters
+    (and already-fired ``times=`` entries) are never reset mid-run and
+    the determinism contract holds.
+    """
+    global _configured_spec
+    if spec is None:
+        spec = os.environ.get(_ENV_VAR)
+        if spec is None or spec == "":
+            return active()
+    if spec is False or spec == "0" or spec == "":
+        clear()
+        return []
+    # Canonicalize through the grammar so strings, FaultSpec objects,
+    # and lists all compare — a replay handing an equivalent schedule
+    # in any spelling is a no-op.
+    specs = _coerce(spec)
+    canon = ",".join(str(s) for s in specs)
+    if _active is not None and canon == _configured_spec:
+        return active()  # idempotent replay: keep the live counters
+    install(specs)
+    _configured_spec = canon
+    return active()
+
+
+def _record(site: str, hit: int, spec: FaultSpec) -> None:
+    try:
+        reg = _telemetry_registry()
+        if reg.enabled:
+            reg.counter("fault.injected", site=site).inc()
+        _tracing.get_tracer().instant(
+            "fault.injected", site=site, hit=hit, spec=str(spec)
+        )
+    except Exception:  # instrumentation must never mask the injection
+        pass
+
+
+def check(site: str) -> None:
+    """Count a hit at ``site`` and raise :class:`FaultInjectedError` when
+    a spec fires. Call sites MUST guard with ``if faults.ARMED:`` — this
+    function is never on a fully-off hot path."""
+    sched = _active
+    if sched is None:
+        return
+    hit = sched.hits.get(site, 0) + 1
+    sched.hits[site] = hit
+    for spec in sched.by_site.get(site, ()):
+        if spec.should_fire(hit):
+            spec.injected += 1
+            sched.injected += 1
+            _record(site, hit, spec)
+            raise FaultInjectedError(site, hit, str(spec))
+
+
+class scope:
+    """Context manager arming ``spec`` on entry and restoring the previous
+    schedule (and guard state) on exit — the chaos-test idiom::
+
+        with faults.scope("data.fetch@step=7"):
+            with pytest.raises(faults.FaultInjectedError):
+                train_loop(...)
+    """
+
+    def __init__(self, spec: Any):
+        self.spec = spec
+        self._saved: _Schedule | None = None
+        self._saved_spec: str | None = None
+
+    def __enter__(self) -> "scope":
+        global _active, ARMED
+        specs = _coerce(self.spec)  # validate BEFORE touching armed state
+        self._saved = _active
+        self._saved_spec = _configured_spec
+        _active = None
+        install(specs)
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        global _active, ARMED, _configured_spec
+        _active = self._saved
+        ARMED = _active is not None
+        _configured_spec = self._saved_spec
+        self._saved = None
+        self._saved_spec = None

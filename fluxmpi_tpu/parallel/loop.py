@@ -46,6 +46,8 @@ from typing import Any, Iterable
 import jax
 import numpy as np
 
+from ..runtime import preemption_handlers_installed, preemption_requested
+from ..telemetry import tracing as _tracing
 from .train import _resolve_metrics
 
 __all__ = ["train_loop"]
@@ -98,6 +100,9 @@ def train_loop(
     in_flight: int = 2,
     flush_every: int = 50,
     metrics: Any | None = None,
+    checkpoint: Any | None = None,
+    save_every: int | None = None,
+    resume: bool = False,
 ) -> tuple[Any, dict[str, Any]]:
     """Drive a compiled train step over a batch source, pipelined.
 
@@ -146,11 +151,45 @@ def train_loop(
         ``train.examples_per_sec``, cumulative ``train.steps`` /
         ``train.examples``.
 
+      checkpoint: a :class:`~fluxmpi_tpu.utils.CheckpointManager` that
+        owns this run's fault-tolerance: periodic saves (``save_every``),
+        the preemption emergency save, and ``resume``. Each save banks a
+        crash-consistent wrapper of the TrainState PLUS the loop
+        counters and the loader position
+        (:meth:`~fluxmpi_tpu.data.DistributedDataLoader.state_dict`), so
+        a restart replays from the exact dispatch boundary — mid-epoch
+        included (see docs/fault_tolerance.md).
+      save_every: checkpoint every N optimizer updates (at dispatch
+        boundaries; requires ``checkpoint``). ``None`` = no periodic
+        saves (preemption still writes an emergency checkpoint when a
+        manager is passed).
+      resume: restore the newest committed checkpoint from
+        ``checkpoint`` before training — state, loop counters, and
+        loader position; an empty directory starts fresh, so the SAME
+        command line is restart-proof. ``steps``/``epochs`` are TOTAL
+        budgets: a run resumed at update 60 with ``steps=100`` runs 40
+        more. Bumps the ``train.resumes`` counter.
+
+    Preemption: when the runtime's preemption flag is set
+    (``init(preemption=True)`` installs the SIGTERM/SIGINT handler; see
+    :func:`fluxmpi_tpu.runtime.request_preemption`), the loop notices at
+    the next dispatch boundary — multi-process runs coordinate the stop
+    and so notice at the next ``flush_every`` boundary instead (the
+    notice can land on different hosts at different dispatch counts;
+    honoring it locally would desync collectives — size ``flush_every``
+    to the preemption grace window, see docs/fault_tolerance.md) —
+    drains the in-flight window, flushes instrumentation, writes an
+    emergency checkpoint (when ``checkpoint`` is passed), and returns
+    cleanly with ``summary["preempted"] = True`` — a
+    ``train.preemption`` instant lands on the trace timeline.
+
     Returns:
       ``(final_state, summary)`` — summary has ``updates``, ``epochs``,
       ``examples``, ``seconds``, ``updates_per_sec``,
-      ``examples_per_sec``, and final ``loss``.
+      ``examples_per_sec``, final ``loss``, ``preempted``, and
+      ``resumed_from`` (the checkpoint step resumed from, else None).
     """
+    from ..data import DistributedDataLoader
     from ..telemetry.watchdog import notify_progress
 
     if in_flight < 0:
@@ -159,6 +198,12 @@ def train_loop(
         raise ValueError(f"flush_every must be >= 1, got {flush_every}")
     if steps is not None and steps < 1:
         raise ValueError(f"steps must be >= 1, got {steps}")
+    if save_every is not None and save_every < 1:
+        raise ValueError(f"save_every must be >= 1, got {save_every}")
+    if save_every is not None and checkpoint is None:
+        raise ValueError("save_every requires a checkpoint= manager")
+    if resume and checkpoint is None:
+        raise ValueError("resume=True requires a checkpoint= manager")
     if steps is None and epochs is None:
         epochs = 1
 
@@ -182,8 +227,28 @@ def train_loop(
     record_metrics = metrics is not None and metrics is not False
     if record_metrics:
         reg, monitor, hook = _resolve_metrics(metrics)
+    from .. import comm as _comm
     from ..telemetry import get_registry
     from .train import _DEFAULT_REGISTRY
+
+    # Multi-process preemption coordination polls only when it could
+    # matter (signal handlers installed, or a checkpoint to bank into) —
+    # an unconditional per-flush host collective would tax runs that
+    # never asked for preemption handling. checkpoint-presence is
+    # SPMD-consistent by construction; handler state is NOT guaranteed to
+    # be (install_preemption_handlers degrades to a warning off the main
+    # thread), so the gate is agreed ONCE via a host max-reduce — any
+    # process with handlers enrolls every process, and no process ever
+    # skips a per-flush collective its peers run.
+    multi = jax.process_count() > 1
+    coordinate = multi and (
+        checkpoint is not None
+        or bool(
+            _comm.host_allreduce(
+                np.int32(preemption_handlers_installed()), op="max"
+            )
+        )
+    )
 
     window: deque = deque()  # outstanding step outputs, oldest first
     updates = 0
@@ -192,6 +257,101 @@ def train_loop(
     interval_updates = 0
     interval_examples = 0
     last_out: Any = None
+
+    def _live_registry() -> Any:
+        return get_registry() if reg is _DEFAULT_REGISTRY else reg
+
+    # ---- fault-tolerance plane: checkpoint payloads, resume ----------
+    is_loader = isinstance(batches, DistributedDataLoader)
+    per_epoch = _epoch_len(batches, k)
+
+    def _payload(st: Any, *, pass_counted: bool = False) -> dict[str, Any]:
+        # What a checkpoint banks: the TrainState plus everything the
+        # loop needs to continue EXACTLY — cumulative counters and the
+        # loader's (epoch, cursor) position. Scalars ride as int64
+        # arrays so they survive the orbax round trip. The banked epoch
+        # count is CANONICAL: it includes the current pass whenever the
+        # cursor sits at the end of the epoch. In-loop saves happen
+        # before the loop's own pass increment (pass_counted=False, so
+        # an exact end-of-pass boundary adds it here); the post-drain
+        # emergency save happens after (pass_counted=True).
+        epochs_banked = epochs_done
+        loader_state = batches.state_dict() if is_loader else None
+        if (
+            loader_state is not None
+            and not pass_counted
+            and len(batches) > 0
+            and loader_state["cursor"] >= len(batches)
+        ):
+            epochs_banked += 1
+        if (
+            loader_state is not None
+            and pass_counted
+            and k > 1
+            and per_epoch
+            and loader_state["cursor"] < len(batches)
+            and loader_state["cursor"] // k >= per_epoch
+        ):
+            # Ragged-scan boundary at a post-drain save: every
+            # dispatchable scan group of this pass ran (the ragged tail
+            # never dispatches) and the pass is already in epochs_banked
+            # — bank the NEXT epoch's start so resume doesn't replay the
+            # empty remainder and count the pass a second time.
+            loader_state = {
+                **loader_state,
+                "epoch": loader_state["epoch"] + 1,
+                "cursor": 0,
+            }
+        payload: dict[str, Any] = {
+            "state": st,
+            "loop": {
+                "updates": np.asarray(updates, np.int64),
+                "examples": np.asarray(examples, np.int64),
+                "epochs": np.asarray(epochs_banked, np.int64),
+            },
+        }
+        if loader_state is not None:
+            payload["loader"] = {
+                key: np.asarray(val, np.int64)
+                for key, val in loader_state.items()
+            }
+        return payload
+
+    resumed_from = None
+    resume_offset = 0  # dispatches already done in a resumed partial epoch
+    if resume:
+        try:
+            ckpt_step, restored = checkpoint.restore(_payload(state))
+        except FileNotFoundError:
+            restored = None  # empty directory: fresh start, same command
+        if restored is not None:
+            state = restored["state"]
+            updates = int(restored["loop"]["updates"])
+            examples = int(restored["loop"]["examples"])
+            epochs_done = int(restored["loop"]["epochs"])
+            if is_loader and "loader" in restored:
+                batches.load_state_dict(
+                    {key: int(val) for key, val in restored["loader"].items()}
+                )
+                # load_state_dict normalized an end-of-epoch cursor away
+                # (the banked epoch count already includes that pass —
+                # _payload's canonical form); what remains is mid-epoch
+                # dispatches already done.
+                resume_offset = batches.resume_cursor // k
+            resumed_from = ckpt_step
+            if record_metrics:
+                registry = _live_registry()
+                if registry is not None:
+                    registry.counter("train.resumes").inc()
+
+    last_saved = updates
+    preempted = False
+
+    def _save_ckpt(pass_counted: bool = False) -> None:
+        nonlocal last_saved
+        checkpoint.save(updates, _payload(state, pass_counted=pass_counted))
+        last_saved = updates
+
     t_start = time.perf_counter()
     t_flush = t_start
 
@@ -224,7 +384,7 @@ def train_loop(
                 record["grad_norm"] = float(
                     np.asarray(jax.device_get(leaves[1])).mean()
                 )
-            registry = get_registry() if reg is _DEFAULT_REGISTRY else reg
+            registry = _live_registry()
             if registry is not None:
                 registry.histogram("train.step_seconds").observe(per_update)
                 if record["loss"] is not None:
@@ -245,11 +405,17 @@ def train_loop(
         t_flush = time.perf_counter()
 
     done = False
-    per_epoch = _epoch_len(batches, k)
     while not done:
         if epochs is not None and epochs_done >= epochs:
             break
-        dispatched_this_epoch = 0
+        if steps is not None and updates >= steps:
+            break  # a resumed run may already have met the total budget
+        # A resumed partial epoch starts its dispatch count at the
+        # restored cursor so full-pass detection stays exact.
+        offset = resume_offset
+        resume_offset = 0
+        dispatched_this_epoch = offset
+        yielded_this_pass = 0
         exhausted = False
         for batch in _epoch_iter(batches, k):
             state, out = hot(state, batch)
@@ -263,10 +429,41 @@ def train_loop(
             interval_updates += k
             interval_examples += n
             dispatched_this_epoch += 1
-            if interval_updates >= flush_every:
+            yielded_this_pass += 1
+            at_flush = interval_updates >= flush_every
+            if at_flush:
                 flush()
             if steps is not None and updates >= steps:
                 done = True
+            # Dispatch-boundary fault-tolerance hooks, in commit order:
+            # bank the boundary first, then honor a pending preemption
+            # (whose emergency save then has nothing left to write).
+            if (
+                checkpoint is not None
+                and save_every is not None
+                and updates - last_saved >= save_every
+            ):
+                _save_ckpt()
+            if multi:
+                # Coordinated stop: a local break would leave the other
+                # processes dispatching collectives this one never joins
+                # (a hang), or desync the emergency save's step-agreement
+                # guard. Every process reaches each flush boundary at
+                # the SAME updates count, so one tiny host max-reduce of
+                # the flag there picks a common stop step. An ungated
+                # multi-process run never breaks locally — that would be
+                # the hang; preemption there needs handlers/checkpoint.
+                if coordinate and at_flush and bool(
+                    _comm.host_allreduce(
+                        np.int32(preemption_requested()), op="max"
+                    )
+                ):
+                    preempted = True
+                    done = True
+            elif preemption_requested():
+                preempted = True
+                done = True
+            if done:
                 break
         else:
             exhausted = True
@@ -274,7 +471,10 @@ def train_loop(
             # Iterator ran dry, or the steps budget landed exactly on the
             # last dispatch of a sized source — either way a full pass.
             epochs_done += 1
-        if not done and dispatched_this_epoch == 0:
+        if not done and yielded_this_pass == 0 and offset == 0:
+            # offset > 0 with nothing yielded is a resumed epoch whose
+            # remainder was all consumed (e.g. only a ragged scan group
+            # was left) — not a dry source; the next pass starts fresh.
             if epochs is not None and epochs_done >= epochs:
                 break
             raise ValueError(
@@ -286,6 +486,16 @@ def train_loop(
     while window:
         jax.block_until_ready(window.popleft())
     flush()
+    if preempted:
+        # Drained and flushed: bank the final boundary and exit cleanly.
+        # The trace instant is the preemption event the schema validates.
+        _tracing.instant("train.preemption", step=int(updates))
+        if checkpoint is not None and updates > last_saved:
+            # Past the epoch-accounting block: a completed pass is
+            # already in epochs_done.
+            _save_ckpt(pass_counted=True)
+    if checkpoint is not None:
+        checkpoint.wait_until_finished()
     seconds = time.perf_counter() - t_start
     loss = None
     if last_out is not None:
@@ -300,5 +510,7 @@ def train_loop(
         "updates_per_sec": updates / seconds if seconds > 0 else 0.0,
         "examples_per_sec": examples / seconds if seconds > 0 else 0.0,
         "loss": loss,
+        "preempted": preempted,
+        "resumed_from": resumed_from,
     }
     return state, summary

@@ -28,6 +28,7 @@ return Python ints, invisible to tracing — the analogue of the reference's
 from __future__ import annotations
 
 import os
+import signal
 import warnings
 from typing import Any, Sequence
 
@@ -52,6 +53,12 @@ __all__ = [
     "local_device_count",
     "global_mesh",
     "dp_axis_name",
+    "preemption_requested",
+    "request_preemption",
+    "clear_preemption",
+    "install_preemption_handlers",
+    "uninstall_preemption_handlers",
+    "preemption_handlers_installed",
 ]
 
 
@@ -62,6 +69,130 @@ class _RuntimeState:
 
 
 _state = _RuntimeState()
+
+
+# ---------------------------------------------------------------------------
+# Preemption plane: SIGTERM/SIGINT → a flag the training loop polls.
+#
+# TPU preemption delivers SIGTERM with a grace window; the handler must be
+# signal-safe, so — same rule as the watchdog's SIGUSR1 handler — it ONLY
+# sets a plain flag (no locks, no I/O, no jax). `train_loop` polls
+# `preemption_requested()` at dispatch boundaries, drains its in-flight
+# window, writes an emergency checkpoint, and returns cleanly with
+# ``summary["preempted"] = True`` (see docs/fault_tolerance.md).
+# ---------------------------------------------------------------------------
+
+_PREEMPTION_ENV = "FLUXMPI_TPU_PREEMPTION"
+
+_SIGNALS_BY_NAME = {
+    "term": (signal.SIGTERM,),
+    "int": (signal.SIGINT,),
+    "both": (signal.SIGTERM, signal.SIGINT),
+}
+
+
+class _PreemptionState:
+    requested: bool = False
+    signum: int | None = None
+
+
+_preemption = _PreemptionState()
+_prev_signal_handlers: dict[int, Any] = {}
+
+
+def preemption_requested() -> bool:
+    """Has a preemption signal (or :func:`request_preemption`) arrived?
+    One attribute read — cheap enough to poll every dispatch."""
+    return _preemption.requested
+
+
+def request_preemption(signum: int | None = None) -> None:
+    """Set the preemption flag programmatically (what the signal handler
+    does; also the test hook — no real signal needed)."""
+    _preemption.requested = True
+    _preemption.signum = signum
+
+
+def clear_preemption() -> None:
+    """Reset the flag (a driver that handled one preemption and decided
+    to continue, or test teardown)."""
+    _preemption.requested = False
+    _preemption.signum = None
+
+
+def _on_preemption_signal(signum: int, frame: Any) -> None:
+    # Runs between bytecodes on the main thread: only a flag write is
+    # safe here (the watchdog signal-safety rule — a handler that took a
+    # registry/IO lock could deadlock the loop it is trying to stop).
+    _preemption.requested = True
+    _preemption.signum = signum
+
+
+def install_preemption_handlers(
+    signals: Sequence[int] = (signal.SIGTERM, signal.SIGINT),
+) -> None:
+    """Install the flag-setting handler for ``signals`` (idempotent; the
+    previous handlers are remembered for
+    :func:`uninstall_preemption_handlers`). Must run on the main thread;
+    elsewhere the install is skipped with a warning (the flag can still
+    be set via :func:`request_preemption`)."""
+    for sig in signals:
+        if sig in _prev_signal_handlers:
+            continue
+        try:
+            _prev_signal_handlers[sig] = signal.signal(
+                sig, _on_preemption_signal
+            )
+        except (ValueError, OSError) as exc:  # non-main thread / platform
+            warnings.warn(
+                f"cannot install preemption handler for signal {sig}: "
+                f"{exc}; preemption polling still works via "
+                f"request_preemption()",
+                stacklevel=2,
+            )
+
+
+def preemption_handlers_installed() -> bool:
+    """Is the flag-setting signal handler currently installed? The
+    install is SPMD-consistent (same ``init(preemption=)`` / env on
+    every process), so multi-process ``train_loop`` gates its
+    coordinated preemption poll on this and every process answers
+    alike."""
+    return bool(_prev_signal_handlers)
+
+
+def uninstall_preemption_handlers() -> None:
+    """Restore the pre-install signal handlers and clear the flag."""
+    for sig, prev in list(_prev_signal_handlers.items()):
+        try:
+            signal.signal(sig, prev)
+        except (ValueError, OSError):
+            pass
+        del _prev_signal_handlers[sig]
+    clear_preemption()
+
+
+def _configure_preemption(spec: Any = None) -> None:
+    """Wire preemption handling from a one-value spec (mirror of
+    ``telemetry.configure``): ``None`` reads ``FLUXMPI_TPU_PREEMPTION``
+    (no-op when unset); ``True``/``"1"``/``"both"`` installs
+    SIGTERM+SIGINT; ``"term"``/``"int"`` installs just that signal;
+    ``False``/``"0"`` uninstalls."""
+    if spec is None:
+        spec = os.environ.get(_PREEMPTION_ENV)
+        if spec is None or spec == "":
+            return
+    if spec is False or spec == "0":
+        uninstall_preemption_handlers()
+        return
+    if spec is True or spec == "1":
+        spec = "both"
+    if not isinstance(spec, str) or spec not in _SIGNALS_BY_NAME:
+        raise ValueError(
+            f"preemption spec must be a bool or one of "
+            f"{sorted(_SIGNALS_BY_NAME)}; got {spec!r}"
+        )
+    install_preemption_handlers(_SIGNALS_BY_NAME[spec])
 
 
 def _should_init_distributed() -> bool:
@@ -92,6 +223,8 @@ def init(
     telemetry: Any = None,
     trace: Any = None,
     watchdog: Any = None,
+    preemption: Any = None,
+    faults: Any = None,
 ) -> Mesh:
     """Bring up the fluxmpi_tpu runtime. Idempotent.
 
@@ -135,6 +268,18 @@ def init(
         :func:`fluxmpi_tpu.telemetry.watchdog.configure`. ``None``
         defers to ``FLUXMPI_TPU_WATCHDOG``. Like ``telemetry``, both are
         applied on idempotent replays too.
+      preemption: install the preemption-signal handler — ``True`` (or
+        ``"both"``) catches SIGTERM+SIGINT, ``"term"``/``"int"`` just
+        one; the handler only sets a flag that
+        :func:`~fluxmpi_tpu.parallel.train_loop` polls at dispatch
+        boundaries (drain, emergency checkpoint, clean return). ``None``
+        defers to ``FLUXMPI_TPU_PREEMPTION``; see
+        docs/fault_tolerance.md.
+      faults: arm a fault-injection schedule (grammar in
+        :mod:`fluxmpi_tpu.faults`, e.g. ``"comm.allreduce@step=7"``).
+        ``None`` defers to ``FLUXMPI_TPU_FAULTS``; ``False`` disarms.
+        All four observability/robustness specs are applied on
+        idempotent replays too.
 
     Returns:
       The global :class:`jax.sharding.Mesh`.
@@ -143,11 +288,14 @@ def init(
     from .telemetry import configure as _configure_telemetry
     from .telemetry import tracing as _tracing
     from .telemetry import watchdog as _watchdog
+    from . import faults as _faults_mod
 
     if _state.initialized:
         _configure_telemetry(telemetry)
         _tracing.configure(trace)
         _watchdog.configure(watchdog)
+        _configure_preemption(preemption)
+        _faults_mod.configure(faults)
         if verbose:
             fluxmpi_println("fluxmpi_tpu already initialized; skipping...")
         assert _state.mesh is not None
@@ -200,6 +348,8 @@ def init(
     _configure_telemetry(telemetry)
     _tracing.configure(trace)
     _watchdog.configure(watchdog)
+    _configure_preemption(preemption)
+    _faults_mod.configure(faults)
 
     if verbose:
         if total_workers() == 1:
@@ -231,13 +381,23 @@ def shutdown() -> None:
     watchdog, exports the trace ring (when a path was configured), and
     flushes/detaches any telemetry sinks so a final partial record is
     never lost — then drops the mesh. Ordered so the trace export still
-    sees the process index."""
+    sees the process index. The fault-tolerance plane resets with the
+    runtime too: a fault schedule or preemption flag left armed across an
+    init/shutdown cycle would make the next run inject faults (or
+    "preempt" at its first dispatch boundary) that nobody asked for."""
     try:
         from .telemetry import shutdown as _telemetry_shutdown
 
         _telemetry_shutdown()
     except Exception:
         pass
+    try:
+        from . import faults as _faults
+
+        _faults.clear()
+    except Exception:
+        pass
+    uninstall_preemption_handlers()
     _state.initialized = False
     _state.mesh = None
 

@@ -174,7 +174,14 @@ def configure(spec: Any = None) -> MetricsRegistry:
             isinstance(s, JSONLSink) and s.path == spec for s in reg.sinks
         ):
             return reg
-        sink = JSONLSink(spec)
+        # A sink pointed at the bench result bank shares the file with
+        # bench.py's merge-by-rename writer — join the shared-JSONL
+        # locking protocol; private streams keep the fast path.
+        bench_jsonl = os.environ.get("FLUXMPI_TPU_BENCH_JSONL")
+        shared = bench_jsonl is not None and os.path.abspath(
+            spec
+        ) == os.path.abspath(bench_jsonl)
+        sink = JSONLSink(spec, shared=shared)
     else:
         raise ValueError(
             f"telemetry spec must be a path, 'console', a Sink, or a "

@@ -59,6 +59,47 @@ METRIC_TYPES = ("counter", "gauge", "histogram")
 
 _HIST_STAT_KEYS = ("sum", "min", "max", "mean", "last")
 
+# Every metric name the framework itself emits. Documentation for readers
+# of a JSONL stream — and, for the namespaces fully owned by the
+# fault-tolerance plane (see _CLOSED_NAMESPACES), an enforced contract:
+# a "fault."/"checkpoint." name outside this set is producer drift, not a
+# user metric. The older namespaces stay open (user code legitimately
+# mints train.my_metric etc.).
+KNOWN_METRIC_NAMES = frozenset(
+    {
+        "comm.calls",
+        "comm.bytes",
+        "comm.block_seconds",
+        "data.batch_fetch_seconds",
+        "data.prefetch_depth",
+        "train.step_seconds",
+        "train.loss",
+        "train.grad_norm",
+        "train.examples_per_sec",
+        "train.steps",
+        "train.examples",
+        "train.resumes",
+        "fault.injected",
+        "checkpoint.retries",
+        "monitor.heartbeat",
+        "monitor.heartbeat_unix",
+        "monitor.step_seconds_local_mean",
+        "monitor.step_seconds_min",
+        "monitor.step_seconds_max",
+        "monitor.step_seconds_mean",
+        "monitor.straggler",
+        "host.memory.peak_rss_bytes",
+    }
+)
+
+_CLOSED_NAMESPACES = ("fault.", "checkpoint.")
+
+# The preemption trace event train_loop emits when it drains and exits on
+# SIGTERM/SIGINT: an instant ("i"/"I") carrying the update count it
+# banked — a span ("X") here would claim a duration preemption does not
+# have, so the validator rejects the wrong phase.
+PREEMPTION_EVENT = "train.preemption"
+
 # Known optional bench keys -> required type(s). Unknown keys pass (new
 # fields must not break old validators); known keys with the wrong type
 # fail (that is the drift being guarded against).
@@ -80,6 +121,10 @@ _BENCH_OPTIONAL: dict[str, tuple[type, ...]] = {
     "assembly_samples_per_sec": (int, float),
     "loader_fed_path": (str,),
     "smoke": (int,),
+    # Which bench config a record (especially a bench_failed one, which
+    # has no device_kind/n_chips) belongs to — part of the JSONL merge
+    # key, so failures from different configs bank as distinct lines.
+    "config": (str,),
 }
 
 
@@ -97,6 +142,11 @@ def validate_metric(m: object, where: str = "metric") -> list[str]:
         errors.append(f"{where}: missing/invalid 'name': {name!r}")
         name = "<unnamed>"
     where = f"{where} {name!r}"
+    if name.startswith(_CLOSED_NAMESPACES) and name not in KNOWN_METRIC_NAMES:
+        errors.append(
+            f"{where}: unknown metric in a framework-owned namespace "
+            f"(known: {sorted(n for n in KNOWN_METRIC_NAMES if n.startswith(_CLOSED_NAMESPACES))})"
+        )
     kind = m.get("type")
     if kind not in METRIC_TYPES:
         errors.append(f"{where}: 'type' must be one of {METRIC_TYPES}, got {kind!r}")
@@ -221,6 +271,17 @@ def validate_trace_event(ev: object, where: str = "traceEvents[]") -> list[str]:
     args = ev.get("args")
     if args is not None and not isinstance(args, dict):
         errors.append(f"{where}: 'args' must be an object")
+    if ev.get("name") == PREEMPTION_EVENT:
+        if ph not in ("i", "I"):
+            errors.append(
+                f"{where}: {PREEMPTION_EVENT!r} must be an instant "
+                f"('i'/'I'), got ph={ph!r}"
+            )
+        if not isinstance(args, dict) or not _is_number(args.get("step")):
+            errors.append(
+                f"{where}: {PREEMPTION_EVENT!r} needs numeric args.step "
+                f"(the update count banked at preemption)"
+            )
     return errors
 
 

@@ -15,14 +15,39 @@ first-class pair with two layouts handled transparently:
   its own shards, and restore lands each leaf directly in its training
   ``NamedSharding``; the state never gathers onto one host (VERDICT r1
   weak #5).
+
+Crash consistency (the commit protocol, see docs/fault_tolerance.md):
+every save writes into a ``<path>.tmp`` staging directory, renames it to
+``<path>``, then fsyncs the sibling layout marker — the **COMMIT
+marker**. Discovery (``all_steps``/``latest_step``/``restore``) only
+believes committed steps, so a crash never yields a partial that
+restores garbage. An overwrite of an existing path decommits the old
+state only AFTER the new bytes are fully staged — a failed or crashed
+write leaves the previous committed checkpoint untouched; the one
+residual window is the few metadata ops between decommit and the
+marker (old gone, new staged-but-uncommitted — discovery skips it and
+startup quarantines it, same as the ``ckpt.commit`` crash window). Transient write failures retry with capped exponential
+backoff (``FLUXMPI_TPU_CKPT_RETRIES`` / ``..._RETRY_BACKOFF_S``), and
+the whole protocol is exercised under :mod:`fluxmpi_tpu.faults` sites
+``ckpt.write`` / ``ckpt.commit`` / ``ckpt.read``.
+
+Multi-process contract: the checkpoint path must live on storage
+**shared by every process** (GCS/NFS — the standard orbax layout). The
+commit marker, discovery, startup quarantine, and the peer-failure abort
+sentinels all read the filesystem at the path, so a per-host local disk
+would leave non-lead processes blind to commits and aborts alike.
 """
 
 from __future__ import annotations
 
+import contextlib
+import glob
+import json
 import os
 import re
 import shutil
 import threading
+import time
 import warnings
 from concurrent.futures import Future, ThreadPoolExecutor
 from concurrent.futures import TimeoutError as _FutureTimeout
@@ -31,9 +56,22 @@ from typing import Any
 import jax
 import numpy as np
 
+from .. import faults as _faults
+from ..errors import CheckpointDesyncError, CheckpointTimeoutError
+from ..errors import FaultInjectedError
 from ..sync import synchronize
+from ..telemetry import get_registry as _telemetry_registry
 
 __all__ = ["save_checkpoint", "restore_checkpoint", "CheckpointManager"]
+
+_ENV_TIMEOUT = "FLUXMPI_TPU_CKPT_TIMEOUT"
+_ENV_RETRIES = "FLUXMPI_TPU_CKPT_RETRIES"
+_ENV_BACKOFF = "FLUXMPI_TPU_CKPT_RETRY_BACKOFF_S"
+_BACKOFF_CAP_S = 5.0
+
+# Injectable sleep (the watchdog's injectable-clock discipline): retry
+# tests monkeypatch this so backoff is asserted, not waited for.
+_retry_sleep = time.sleep
 
 
 def _checkpointer():
@@ -42,26 +80,95 @@ def _checkpointer():
     return ocp.PyTreeCheckpointer()
 
 
+def _hard_deadline_s() -> float | None:
+    """Optional hard cap on checkpoint waits (``FLUXMPI_TPU_CKPT_TIMEOUT``
+    seconds; unset/empty/0 = off, the historical warn-forever behavior)."""
+    raw = os.environ.get(_ENV_TIMEOUT)
+    if not raw:
+        return None
+    deadline = float(raw)
+    return deadline if deadline > 0 else None
+
+
 def _wait_with_diagnostic(
     fut: Future, what: str, warn_after_s: float = 60.0
 ) -> None:
     """``fut.result()`` that surfaces a wedge instead of hanging silently:
     a background save that never completes (e.g. one process missing a
     cross-process barrier) cannot be forced to finish, but the periodic
-    warning turns an inexplicable hang into a diagnosable one (ADVICE r3)."""
+    warning turns an inexplicable hang into a diagnosable one (ADVICE r3).
+    With ``FLUXMPI_TPU_CKPT_TIMEOUT`` set, the wait gives up past that
+    deadline and raises :class:`~fluxmpi_tpu.errors.CheckpointTimeoutError`
+    instead of warning forever — for orchestrators that would rather
+    fail-fast and reschedule than hold a wedged slot."""
+    deadline = _hard_deadline_s()
     waited = 0.0
     while True:
+        timeout = warn_after_s
+        if deadline is not None:
+            timeout = min(timeout, max(deadline - waited, 0.001))
         try:
-            fut.result(timeout=warn_after_s)
+            fut.result(timeout=timeout)
             return
         except _FutureTimeout:
-            waited += warn_after_s
+            waited += timeout
+            if deadline is not None and waited >= deadline:
+                raise CheckpointTimeoutError(
+                    f"{what} did not complete within the "
+                    f"{_ENV_TIMEOUT}={deadline:.0f}s hard deadline — "
+                    f"giving up on a probable cross-process barrier wedge "
+                    f"(a peer process exited or diverged; see the "
+                    f"watchdog/flight-recorder dumps for which collective "
+                    f"it died in)"
+                ) from None
             warnings.warn(
                 f"{what} has not completed after {waited:.0f}s — possible "
                 f"cross-process barrier wedge (a peer process may have "
                 f"exited or diverged); still waiting",
                 stacklevel=2,
             )
+
+
+def _with_write_retries(fn, what: str, *, collective: bool = False) -> None:
+    """Run a checkpoint write attempt with capped exponential backoff on
+    transient failures (``OSError`` — and :class:`FaultInjectedError`,
+    which is how chaos tests exercise exactly this loop). Each retry
+    bumps the ``checkpoint.retries`` counter. ``collective=True``
+    disables the retry loop entirely: in a multi-process world *both*
+    orbax save paths run cross-process coordination internally (multihost
+    sync barriers), and one process re-entering the save unilaterally
+    pairs those barriers with nobody — the retry attempt itself wedges,
+    so no retry cap would ever be reached while the peers advance to the
+    post-write barrier. A transient multi-process failure instead aborts
+    the whole save through the peer-failure protocol
+    (cross-process-consistent, previous committed checkpoint intact);
+    the caller retries the *entire* save if it wants another attempt."""
+    retries = 0 if collective else int(os.environ.get(_ENV_RETRIES, "3"))
+    delay = float(os.environ.get(_ENV_BACKOFF, "0.1"))
+    for attempt in range(retries + 1):
+        try:
+            if _faults.ARMED:
+                _faults.check("ckpt.write")
+            fn()
+            return
+        except (OSError, FaultInjectedError) as exc:
+            if attempt >= retries:
+                raise
+            try:
+                reg = _telemetry_registry()
+                if reg.enabled:
+                    reg.counter("checkpoint.retries").inc()
+            except Exception:
+                pass
+            warnings.warn(
+                f"{what} attempt {attempt + 1} failed transiently "
+                f"({exc!r}); retrying in {min(delay, _BACKOFF_CAP_S):.2f}s "
+                f"({retries - attempt} retr"
+                f"{'y' if retries - attempt == 1 else 'ies'} left)",
+                stacklevel=3,
+            )
+            _retry_sleep(min(delay, _BACKOFF_CAP_S))
+            delay *= 2.0
 
 
 def _process_barrier(name: str) -> None:
@@ -84,6 +191,47 @@ def _process_barrier(name: str) -> None:
         multihost_utils.sync_global_devices(name)
 
 
+def _peer_write_failures(tmp: str) -> list[int]:
+    """The ranks whose write attempt terminally failed, read from the
+    ``<tmp>.write_failed.<rank>`` sentinels on the shared checkpoint
+    storage (every process calls this after the post-write barrier, so
+    all sentinels have landed). The signal deliberately rides the
+    checkpoint filesystem, NOT a collective: the abort decision is made
+    inside :func:`save_checkpoint`, which runs on the
+    :class:`CheckpointManager` background thread for async saves — a
+    device collective there is the submission-order inversion
+    :func:`_process_barrier` exists to avoid. The flip side is that the
+    sentinel must be visible to every process, which the shared-storage
+    contract (module docstring) guarantees. Module-level so chaos tests
+    can monkeypatch a failed peer."""
+    return sorted(
+        int(s.rsplit(".", 1)[-1])
+        for s in glob.glob(glob.escape(tmp) + ".write_failed.*")
+    )
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a directory so renames/creates/removals of its entries are
+    durable — fsyncing a new file orders its *bytes*, but the directory
+    entry itself (and a rename) lives in the parent's metadata, which
+    journaling filesystems may commit seconds later. Without this, a
+    power cut after ``save_checkpoint`` returns could surface a world
+    where the OLD checkpoint's decommit persisted but the new rename +
+    marker did not — no committed checkpoint at all. Best-effort: object
+    stores and exotic platforms without directory fds skip silently
+    (their rename/visibility semantics differ anyway)."""
+    try:
+        fd = os.open(path, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover - platform-dependent
+        pass
+    finally:
+        os.close(fd)
+
+
 def _is_sharded_tree(tree: Any) -> bool:
     """True when any leaf is laid out non-replicated over >1 device (an
     FSDP/TP state) — the layouts that must never host-gather."""
@@ -102,9 +250,20 @@ def _layout_marker_path(path: str) -> str:
 
 
 def _write_layout_marker(path: str, layout: str) -> None:
+    """Write the layout marker — the COMMIT point of the save protocol.
+    fsync'd so a machine crash right after the rename cannot leave a
+    marker the filesystem later loses while keeping the (older) rename:
+    once this returns, the step is durably committed."""
     if jax.process_index() == 0:
-        with open(_layout_marker_path(path), "w") as f:
+        marker = _layout_marker_path(path)
+        with open(marker, "w") as f:
             f.write(layout)
+            f.flush()
+            os.fsync(f.fileno())
+        # The file fsync made the marker's BYTES durable; its directory
+        # entry is parent metadata and needs its own fsync before the
+        # "once this returns, the step is durably committed" claim holds.
+        _fsync_dir(os.path.dirname(marker))
 
 
 def _read_layout_marker(path: str) -> str | None:
@@ -165,24 +324,135 @@ def save_checkpoint(path: str, state: Any, *, force: bool = True) -> None:
     everywhere); sharded FSDP/TP state writes collectively, each process
     its own shards. All processes must call (collective barrier at the end)
     so the flow is SPMD-safe.
+
+    Crash-consistent: bytes land in ``<path>.tmp``, which is renamed to
+    ``path`` and only then committed by the fsync'd layout marker — a
+    crash anywhere in between leaves an uncommitted directory that
+    discovery skips and :class:`CheckpointManager` quarantines at
+    startup. Transient write failures retry with capped exponential
+    backoff (env knobs in the module docstring).
     """
     path = os.path.abspath(path)
-    if _is_sharded_tree(state):
-        _save_sharded(path, state, force)
-        _write_layout_marker(path, "sharded")
-    else:
-        # Every process enters the (collective) orbax save — its multihost
-        # coordination barriers require all participants; orbax's
-        # primary-host logic ensures only the lead process actually writes
-        # the replicated bytes.
-        host_state = jax.tree_util.tree_map(
-            lambda x: np.asarray(jax.device_get(x))
-            if isinstance(x, (jax.Array, np.ndarray))
-            else x,
-            state,
+    layout = "sharded" if _is_sharded_tree(state) else "replicated"
+    marker = _layout_marker_path(path)
+    tmp = path + ".tmp"
+    lead = jax.process_index() == 0
+    if not force and (os.path.exists(marker) or os.path.exists(path)):
+        # Every process checks (checkpoint storage is shared) so the
+        # refusal raises SPMD-consistently — a lead-only raise would
+        # strand the other processes at the barrier below.
+        raise FileExistsError(
+            f"checkpoint already exists at {path} (pass force=True "
+            f"to overwrite)"
         )
-        _checkpointer().save(path, host_state, force=force)
-        _write_layout_marker(path, "replicated")
+    shutil.rmtree(tmp, ignore_errors=True)  # stale staging dir
+    for stale in glob.glob(glob.escape(tmp) + ".write_failed.*"):
+        with contextlib.suppress(OSError):
+            os.remove(stale)
+    _process_barrier(f"ckpt_preclean:{path}")
+    write_exc: BaseException | None = None
+    # Per-process retries are only safe when the write attempt has no
+    # cross-process coordination inside it — true only in a
+    # single-process world (see _with_write_retries).
+    collective = jax.process_count() > 1
+    try:
+        if layout == "sharded":
+            _with_write_retries(
+                lambda: _save_sharded(tmp, state, True),
+                f"sharded checkpoint write to {tmp}",
+                collective=collective,
+            )
+        else:
+            # Every process enters the (collective) orbax save — its
+            # multihost coordination barriers require all participants;
+            # orbax's primary-host logic ensures only the lead process
+            # actually writes the replicated bytes.
+            host_state = jax.tree_util.tree_map(
+                lambda x: np.asarray(jax.device_get(x))
+                if isinstance(x, (jax.Array, np.ndarray))
+                else x,
+                state,
+            )
+            _with_write_retries(
+                lambda: _checkpointer().save(tmp, host_state, force=True),
+                f"checkpoint write to {tmp}",
+                collective=collective,
+            )
+    except (OSError, FaultInjectedError) as exc:
+        # Terminal (retry-exhausted) local failure: tell the peers via a
+        # sentinel on the shared checkpoint storage BEFORE joining the
+        # barrier, so after it every process reads the same failed set
+        # and they abort the save together instead of wedging. Best-effort
+        # — if even the sentinel cannot land (whole filesystem down),
+        # peers fall back to the barrier-wedge diagnostics
+        # (_wait_with_diagnostic / FLUXMPI_TPU_CKPT_TIMEOUT).
+        write_exc = exc
+        with contextlib.suppress(OSError):
+            with open(
+                f"{tmp}.write_failed.{jax.process_index()}",
+                "w",
+                encoding="utf-8",
+            ) as f:
+                f.write(repr(exc))
+    _process_barrier(f"ckpt_written:{path}")
+    failed = _peer_write_failures(tmp)
+    # Every process reads the failed set BEFORE anyone may delete a
+    # sentinel: without this barrier a fast aborter's cleanup below could
+    # race a slow peer's glob above — the slow peer would see an empty
+    # set, take the commit path alone, and decommit the previous
+    # committed checkpoint while everyone else aborts.
+    _process_barrier(f"ckpt_failcheck:{path}")
+    if write_exc is not None or failed:
+        # Abort on EVERY process (the sentinels landed before ckpt_written
+        # and were read before ckpt_failcheck, so the failed set — and
+        # this branch — is agreed), previous committed checkpoint intact:
+        # the decommit below never ran. Cleanup is idempotent per process.
+        shutil.rmtree(tmp, ignore_errors=True)
+        for s in glob.glob(glob.escape(tmp) + ".write_failed.*"):
+            with contextlib.suppress(OSError):
+                os.remove(s)
+        _process_barrier(f"ckpt_abort:{path}")
+        if write_exc is not None:
+            raise write_exc
+        raise OSError(
+            f"checkpoint write to {tmp} failed on peer process(es) "
+            f"{failed} after retries (see their logs); aborted on all "
+            f"processes — the previous committed checkpoint at {path} "
+            f"is untouched"
+        )
+    # Decommit any OLD state at the path only now that the new bytes
+    # are fully staged: a failed or crashed write above leaves the
+    # previous committed checkpoint untouched. Marker removal comes
+    # first so an interrupted cleanup leaves nothing discovery would
+    # trust. Every process issues the removals — on the shared storage
+    # the concurrent removals are idempotent, and the symmetry keeps the
+    # flow SPMD-uniform (no lead/non-lead divergence to coordinate).
+    try:
+        os.remove(marker)
+    except FileNotFoundError:
+        pass
+    shutil.rmtree(path, ignore_errors=True)
+    _process_barrier(f"ckpt_decommit:{path}")  # removals land pre-rename
+    # Rename on EVERY process that sees a staging dir: the first rename
+    # wins and the rest find the staging dir gone — same SPMD-uniform
+    # symmetry as the decommit above, with the race handled explicitly.
+    if os.path.isdir(tmp):
+        try:
+            os.rename(tmp, path)
+        except OSError:
+            if not os.path.isdir(path):  # lost a shared-storage race: ok
+                raise
+    # The rename is an entry in the PARENT directory's metadata — make it
+    # durable before the marker can declare the step committed (see
+    # _fsync_dir: without this a post-return power cut could keep the
+    # decommit but lose the rename).
+    _fsync_dir(os.path.dirname(path))
+    if lead and _faults.ARMED:
+        # The crash-between-rename-and-commit window, injectable.
+        _faults.check("ckpt.commit")
+    _process_barrier(f"ckpt_commit:{path}")  # every rename lands first
+    if lead:
+        _write_layout_marker(path, layout)
     _process_barrier(f"ckpt_save:{path}")
 
 
@@ -210,6 +480,8 @@ def restore_checkpoint(
     checkpoint fully replicated on one host) is usually an accident, so
     the layout marker rejects it unless ``allow_layout_change=True``.
     """
+    if _faults.ARMED:
+        _faults.check("ckpt.read")
     path = os.path.abspath(path)
     if _is_sharded_tree(like):
         if not allow_layout_change:
@@ -266,6 +538,20 @@ def restore_checkpoint(
 _STEP_DIR_RE = re.compile(r"^step_(\d{8})$")
 
 
+def _gather_steps(step: int) -> np.ndarray | None:
+    """Every process's view of the step about to be saved (``None`` =
+    single-process world, nothing to compare). ONE cheap host allgather
+    on the caller thread — never a device collective, never on the
+    background save thread (submission-order inversion, see
+    :func:`_process_barrier`). Module-level so chaos tests can
+    monkeypatch a desynced world."""
+    if jax.process_count() == 1:
+        return None
+    from ..comm import host_allgather  # pragma: no cover - multihost only
+
+    return host_allgather(np.asarray(step, np.int64))
+
+
 class CheckpointManager:
     """Training-run checkpoint lifecycle on top of
     :func:`save_checkpoint`/:func:`restore_checkpoint` (VERDICT r2 next #7;
@@ -283,7 +569,18 @@ class CheckpointManager:
       save); sharded state always saves synchronously (collective);
       :meth:`wait_until_finished` joins;
     - **resume discovery**: :meth:`latest_step` / :meth:`restore` with
-      ``step=None`` find the newest complete checkpoint.
+      ``step=None`` find the newest complete checkpoint;
+    - **partial quarantine**: startup sweeps the directory for
+      uncommitted step dirs and stale ``.tmp`` staging dirs (a crash
+      mid-save) and moves them into ``_quarantine/`` — they are already
+      invisible to discovery, but leaving them in place would let a
+      torn tree shadow a later save of the same step;
+    - **step-agreement guard**: before each save one cheap
+      :func:`~fluxmpi_tpu.comm.host_allgather` asserts every process is
+      checkpointing the SAME step; on desync the save aborts with
+      :class:`~fluxmpi_tpu.errors.CheckpointDesyncError` and the
+      collective flight-recorder tail is dumped beside the directory —
+      a mixed-step "checkpoint" is corruption, not a checkpoint.
 
     All methods must be called on every process (saves/restores of sharded
     state are collective).
@@ -299,6 +596,7 @@ class CheckpointManager:
         self.directory = os.path.abspath(directory)
         self.max_to_keep = max_to_keep
         os.makedirs(self.directory, exist_ok=True)
+        self.quarantined = self._quarantine_partials()
         self._executor = (
             ThreadPoolExecutor(max_workers=1, thread_name_prefix="ckpt")
             if async_save
@@ -306,6 +604,87 @@ class CheckpointManager:
         )
         self._pending: Future | None = None
         self._lock = threading.Lock()
+
+    def _quarantine_partials(self) -> list[str]:
+        """Move uncommitted step dirs / stale staging dirs into
+        ``_quarantine/`` (lead process; barrier'd so no peer races a
+        restore against the sweep). Returns the quarantined names."""
+        moved: list[str] = []
+        removed: list[str] = []
+        if jax.process_index() == 0:
+            qdir = os.path.join(self.directory, "_quarantine")
+            for name in sorted(os.listdir(self.directory)):
+                full = os.path.join(self.directory, name)
+                partial = os.path.isdir(full) and (
+                    name.endswith(".tmp")
+                    or (
+                        _STEP_DIR_RE.match(name)
+                        and _read_layout_marker(full) is None
+                    )
+                )
+                orphan_marker = (
+                    name.endswith(".fluxmpi_layout")
+                    and not os.path.isdir(full[: -len(".fluxmpi_layout")])
+                )
+                if orphan_marker:
+                    # A marker whose directory vanished (crash mid-
+                    # retention): committed-looking but unrestorable.
+                    os.remove(full)
+                    removed.append(name)
+                    continue
+                if not partial:
+                    continue
+                os.makedirs(qdir, exist_ok=True)
+                target = os.path.join(qdir, name)
+                suffix = 0
+                while os.path.exists(target):
+                    suffix += 1
+                    target = os.path.join(qdir, f"{name}.{suffix}")
+                os.rename(full, target)
+                moved.append(name)
+            if moved or removed:
+                parts = []
+                if moved:
+                    parts.append(
+                        f"quarantined {len(moved)} partial checkpoint "
+                        f"artifact(s) under {qdir}: {moved}"
+                    )
+                if removed:
+                    parts.append(
+                        f"removed {len(removed)} orphan commit "
+                        f"marker(s): {removed}"
+                    )
+                warnings.warn(
+                    "; ".join(parts) + " — a previous run crashed "
+                    "mid-save; the newest COMMITTED step is unaffected",
+                    stacklevel=3,
+                )
+        _process_barrier(f"ckpt_quarantine:{self.directory}")
+        return moved + removed
+
+    def _check_step_agreement(self, step: int) -> None:
+        gathered = _gather_steps(step)
+        if gathered is None or bool((gathered == gathered.flat[0]).all()):
+            return
+        from ..telemetry.flight_recorder import get_flight_recorder
+
+        dump_path = os.path.join(
+            self.directory,
+            f"ckpt_desync_flight.{jax.process_index()}.json",
+        )
+        try:
+            with open(dump_path, "w", encoding="utf-8") as f:
+                json.dump(get_flight_recorder().dump(), f, indent=1)
+        except Exception:  # the abort matters more than the dump
+            dump_path = "<flight dump failed>"
+        raise CheckpointDesyncError(
+            f"processes disagree on the checkpoint step: "
+            f"{np.asarray(gathered).ravel().tolist()} — aborting the save "
+            f"instead of banking a mixed-step checkpoint; flight-recorder "
+            f"context written to {dump_path} (diff per-host dumps with "
+            f"fluxmpi_tpu.telemetry.diff_flight_dumps to localize the "
+            f"desync)"
+        )
 
     def _step_path(self, step: int) -> str:
         return os.path.join(self.directory, f"step_{step:08d}")
@@ -339,7 +718,12 @@ class CheckpointManager:
         caller's next ``step(state, …)`` would tear the device buffers out
         from under a background ``device_get``. Sharded (FSDP/TP) state
         cannot be host-snapshotted without gathering, so its save runs
-        synchronously (orbax still writes only per-process shards)."""
+        synchronously (orbax still writes only per-process shards).
+
+        Aborts with :class:`~fluxmpi_tpu.errors.CheckpointDesyncError`
+        (flight-recorder context dumped) when processes disagree on
+        ``step`` — checked on the caller thread, before any bytes move."""
+        self._check_step_agreement(step)
         if self._executor is None or _is_sharded_tree(state):
             self.wait_until_finished()
             self._save_and_retain(step, state, force)

@@ -8,7 +8,12 @@ Usage:
 
 - ``*.jsonl`` files: every line must be a valid telemetry flush record
   (schema "fluxmpi_tpu.telemetry/v1"); a line carrying a ``bench`` key
-  must also embed a valid bench record.
+  must also embed a valid bench record. Metric names in the
+  framework-owned ``fault.`` / ``checkpoint.`` namespaces must come from
+  ``schema.KNOWN_METRIC_NAMES`` (``fault.injected``,
+  ``checkpoint.retries``; ``train.resumes`` and the
+  ``train.preemption`` trace instant are validated the same way) —
+  producer drift there fails the check.
 - ``*.json`` files carrying ``"schema": "fluxmpi_tpu.trace/v1"``:
   dispatched on ``kind`` — a trace export (``Tracer.export`` /
   ``scripts/merge_traces.py`` output), a flight-recorder dump, or a

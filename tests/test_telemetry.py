@@ -134,6 +134,48 @@ def test_jsonl_sink_round_trip(tmp_path):
     assert lines[1]["extra_field"] == "ok"
 
 
+def test_jsonl_sink_private_stream_keeps_fast_path(tmp_path):
+    # Default (non-shared) sink: persistent handle, no .lock sidecar.
+    path = str(tmp_path / "private.jsonl")
+    sink = JSONLSink(path)
+    sink.write({"a": 1})
+    sink.write({"a": 2})
+    assert not os.path.exists(path + ".lock")
+    sink.close()
+    assert [json.loads(l)["a"] for l in open(path)] == [1, 2]
+
+
+def test_jsonl_sink_shared_survives_merge_by_rename(tmp_path):
+    # shared=True reopens per line: a merge-by-rename writer swapping the
+    # inode between writes must not strand the sink on the unlinked file.
+    path = str(tmp_path / "bank.jsonl")
+    sink = JSONLSink(path, shared=True)
+    sink.write({"a": 1})
+    os.rename(path, path + ".merged")  # simulate bench's replace
+    sink.write({"a": 2})
+    assert [json.loads(l)["a"] for l in open(path)] == [2]
+    assert os.path.exists(path + ".lock")
+    sink.close()
+
+
+def test_configure_marks_bench_bank_path_shared(tmp_path, monkeypatch):
+    bank = str(tmp_path / "bank.jsonl")
+    other = str(tmp_path / "other.jsonl")
+    monkeypatch.setenv("FLUXMPI_TPU_BENCH_JSONL", bank)
+    try:
+        configure(bank)
+        configure(other)
+        by_path = {
+            s.path: s for s in get_registry().sinks if isinstance(s, JSONLSink)
+        }
+        assert by_path[bank].shared is True
+        assert by_path[other].shared is False
+    finally:
+        for s in list(get_registry().sinks):
+            if isinstance(s, JSONLSink) and s.path in (bank, other):
+                get_registry().remove_sink(s)
+
+
 def test_memory_and_null_sinks_and_close():
     mem = MemorySink()
     reg = MetricsRegistry(sinks=[mem, NullSink()])
