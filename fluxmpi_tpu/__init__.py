@@ -36,6 +36,7 @@ from .errors import (  # noqa: F401
     CheckpointTimeoutError,
     FaultInjectedError,
     FluxMPINotInitializedError,
+    TopologyMismatchError,
 )
 from .runtime import (  # noqa: F401
     Initialized,

@@ -222,6 +222,24 @@ class DistributedDataLoader:
         is a cross-process collective); ``False`` keeps the host path.
         A ragged tail batch (``drop_last=False``) always assembles on
         the host — a short gather would retrigger XLA compilation.
+      elastic_order: assign samples to global batches **batch-major** —
+        global batch ``b`` covers positions ``[b*gbs, (b+1)*gbs)`` of the
+        (possibly shuffled) full-dataset order, and each process takes
+        its contiguous ``gbs/process_count`` slice of *that batch* — so
+        which samples batch ``b`` holds does not depend on the process
+        count. This is the topology-invariant order elastic resume needs
+        for multi-process sample-exactness: after ``cursor`` batches,
+        exactly the first ``cursor * gbs`` positions of the epoch order
+        are consumed, on ANY process count (see docs/fault_tolerance.md,
+        "Elastic resume"). Single-process iteration is already
+        batch-major, so the flag only changes behavior under
+        ``process_count > 1``, where it requires a default-sharded
+        :class:`DistributedDataContainer` (the full-dataset view) and
+        ``drop_last=True`` (the trailing ``total % gbs`` samples are
+        dropped — the ragged-remainder round-down). With ``shuffle`` (or
+        ``global_shuffle``) the order is the seeded full-dataset
+        permutation, identical on every process. Default False: the
+        reference's fixed contiguous shards.
       transform_with_rng: explicitly declare the transform's call shape:
         ``True`` → ``transform(batch, rng)``, ``False`` →
         ``transform(batch)``. Default ``None`` falls back to, in order:
@@ -260,6 +278,7 @@ class DistributedDataLoader:
         drop_last: bool = True,
         prefetch: int = 2,
         device_gather: bool | str = "auto",
+        elastic_order: bool = False,
         transform: Any = None,
         transform_with_rng: bool | None = None,
     ):
@@ -269,6 +288,24 @@ class DistributedDataLoader:
                 "which needs the full-dataset view of a "
                 "DistributedDataContainer; wrap the dataset in one"
             )
+        self.elastic_order = bool(elastic_order)
+        if self.elastic_order and jax.process_count() > 1:
+            if not isinstance(data, DistributedDataContainer) or (
+                data.world != jax.process_count()
+                or data.rank != jax.process_index()
+            ):
+                raise ValueError(
+                    "elastic_order needs the full-dataset view of a "
+                    "default-sharded DistributedDataContainer (rank/world "
+                    "matching the process world): the batch-major sample "
+                    "assignment is computed from the whole dataset"
+                )
+            if not drop_last:
+                raise ValueError(
+                    "elastic_order requires drop_last=True: the trailing "
+                    "total %% global_batch_size samples round down so the "
+                    "epoch is a whole number of topology-invariant batches"
+                )
         self.data = data
         self.mesh = mesh
         self.axis_name = axis_name or config.DP_AXIS_NAME
@@ -383,7 +420,18 @@ class DistributedDataLoader:
         # cross-process collective, so every process MUST yield the same
         # number of batches or iteration deadlocks mid-epoch. Compute the
         # common (minimum) serveable length once.
-        if isinstance(data, DistributedDataContainer):
+        if (
+            self.elastic_order
+            and jax.process_count() > 1
+            and isinstance(data, DistributedDataContainer)
+        ):  # pragma: no cover - multihost only
+            # Batch-major epoch: total // gbs whole global batches, each
+            # contributing exactly local_batch_size samples per process —
+            # identical on every process by construction.
+            self._common_len = (
+                data.total_size // global_batch_size
+            ) * self.local_batch_size
+        elif isinstance(data, DistributedDataContainer):
             self._common_len = data.min_shard_size()
         elif jax.process_count() > 1:  # pragma: no cover - multihost only
             from .comm import host_allreduce
@@ -442,13 +490,41 @@ class DistributedDataLoader:
             "seed": self.seed,
         }
 
+    def geometry(self) -> dict[str, int]:
+        """The batch geometry a cursor's *meaning* depends on, as plain
+        ints — banked next to :meth:`state_dict` (``train_loop`` merges
+        both into its checkpoint payload, and the save-time manifest
+        records a copy) so :meth:`load_state_dict` under a different
+        topology can re-derive the cursor instead of misreading it."""
+        return {
+            "process_count": jax.process_count(),
+            "global_batch_size": self.global_batch_size,
+            "num_batches": len(self),
+            "elastic_order": int(self.elastic_order),
+        }
+
     def load_state_dict(self, state: dict[str, Any]) -> None:
         """Restore a :meth:`state_dict`: the next ``iter()`` replays
         ``epoch``'s permutation starting at batch ``cursor`` —
         mid-epoch-exact on the host, native, and device-gather paths
         (skipped batches are index arithmetic, nothing is fetched). A
         cursor at the end of the epoch resumes at the next epoch's
-        first batch."""
+        first batch.
+
+        Elastic resume: when ``state`` also carries the saving loader's
+        :meth:`geometry` and it differs from this loader's (the run was
+        preempted on N hosts and resumes on M, or the global batch size
+        changed), the cursor is remapped through the **global sample
+        offset** it denotes — ``cursor * saved global_batch_size``
+        samples of the epoch were consumed — rounding DOWN to the last
+        whole new-width batch; the few samples of a partial batch that
+        get re-seen are counted and logged (none are skipped). The
+        remapped position is sample-exact whenever the sample→batch
+        assignment is topology-invariant: always in a single-process
+        world, and under ``elastic_order=True`` across process counts
+        (a warning names the caveat otherwise). A ``state`` without
+        geometry (pre-elastic checkpoint) is assumed same-topology and
+        fails with a topology-naming error if its cursor cannot fit."""
         seed = int(state.get("seed", self.seed))
         if seed != self.seed:
             raise ValueError(
@@ -458,9 +534,30 @@ class DistributedDataLoader:
             )
         epoch = int(state["epoch"])
         cursor = int(state["cursor"])
-        if cursor < 0 or cursor > len(self):
+        geom = self.geometry()
+        saved_geom = {
+            key: int(state[key]) for key in geom if key in state
+        }
+        have_geom = all(
+            key in saved_geom
+            for key in ("process_count", "global_batch_size", "num_batches")
+        )
+        if have_geom and any(saved_geom[k] != geom[k] for k in saved_geom):
+            cursor = self._remap_cursor(cursor, saved_geom)
+        elif cursor < 0 or cursor > len(self):
+            hint = (
+                " — the state carries no batch geometry (a pre-elastic "
+                "checkpoint), so it can only resume on the topology that "
+                f"saved it; this loader spans {geom['process_count']} "
+                f"process(es) at global batch {geom['global_batch_size']}, "
+                "and a cursor that does not fit usually means the saving "
+                "run had a different process count or batch size"
+                if not have_geom
+                else ""
+            )
             raise ValueError(
-                f"cursor {cursor} out of range for a {len(self)}-batch epoch"
+                f"cursor {cursor} out of range for a {len(self)}-batch "
+                f"epoch{hint}"
             )
         if cursor >= len(self):  # epoch fully consumed: resume at the next
             epoch, cursor = epoch + 1, 0
@@ -468,6 +565,84 @@ class DistributedDataLoader:
         self._iter_epoch = epoch
         self._cursor = cursor
         self._resume_cursor = cursor
+
+    def _remap_cursor(self, cursor: int, saved: dict[str, int]) -> int:
+        """N→M cursor remap (docs/fault_tolerance.md, "Elastic resume"):
+        the banked cursor meant ``cursor * saved_gbs`` global samples of
+        the epoch consumed; re-derive this loader's cursor from that
+        offset, rounding down to the last whole new-width batch."""
+        import warnings
+
+        old_gbs = saved["global_batch_size"]
+        old_len = saved["num_batches"]
+        if cursor < 0 or cursor > old_len:
+            raise ValueError(
+                f"cursor {cursor} out of range for the saved "
+                f"{old_len}-batch epoch (saved geometry: "
+                f"{saved['process_count']} process(es), global batch "
+                f"{old_gbs})"
+            )
+        if cursor >= old_len:
+            # The saved pass was COMPLETE (the banked epoch count
+            # includes it — train_loop's canonical form). It must stay
+            # complete under the new width even when the new epoch
+            # covers more samples (old ragged tail < new coverage):
+            # landing mid-epoch would replay the tail of an
+            # already-counted pass and double-count the epoch.
+            return len(self)
+        offset = cursor * old_gbs  # global samples consumed this epoch
+        new_gbs = self.global_batch_size
+        new_cursor = offset // new_gbs
+        reseen = 0
+        if new_cursor >= len(self):
+            # An INCOMPLETE old pass (the complete case returned above)
+            # whose offset reaches past the new geometry's whole-batch
+            # coverage: the old epoch's last few samples fall into the
+            # new width's ragged tail. They are dropped — the same fate
+            # drop_last gives a fresh epoch's tail — but the round-down
+            # contract promises counted skips, so say so.
+            warnings.warn(
+                f"elastic resume remapped the loader cursor {cursor} "
+                f"(global batch {old_gbs}) past the new geometry's "
+                f"whole-batch coverage ({len(self)} × {new_gbs}): the "
+                f"interrupted epoch's remaining "
+                f"{old_len * old_gbs - offset} sample(s) fall into the "
+                f"new width's ragged tail and are dropped — resuming at "
+                f"the next epoch",
+                stacklevel=3,
+            )
+            new_cursor = len(self)
+        else:
+            reseen = offset - new_cursor * new_gbs
+        # Sample-exactness needs a topology-invariant sample→batch
+        # assignment on BOTH sides: a single-process world is batch-major
+        # by construction, a multi-process one only under elastic_order.
+        saved_batch_major = saved["process_count"] == 1 or bool(
+            saved.get("elastic_order", 0)
+        )
+        here_batch_major = jax.process_count() == 1 or self.elastic_order
+        if not (saved_batch_major and here_batch_major):
+            warnings.warn(
+                "elastic cursor remap with a multi-process side not "
+                "built with elastic_order=True: fixed contiguous shards "
+                "reassign samples to workers when the world resizes, so "
+                "the resumed epoch is sample-exact only in expectation — "
+                "construct multi-process loaders with elastic_order=True "
+                "for the exact contract",
+                stacklevel=3,
+            )
+        if reseen:
+            warnings.warn(
+                f"elastic resume remapped the loader cursor {cursor} "
+                f"(global batch {old_gbs}, {saved['process_count']} "
+                f"process(es)) to {new_cursor} (global batch {new_gbs}, "
+                f"{jax.process_count()} process(es)); the offset lands "
+                f"mid-batch, so {reseen} already-consumed sample(s) are "
+                f"re-seen (rounded down to the last whole batch — none "
+                f"skipped)",
+                stacklevel=3,
+            )
+        return new_cursor
 
     @property
     def resume_cursor(self) -> int:
@@ -488,6 +663,19 @@ class DistributedDataLoader:
             cached = (mesh, NamedSharding(mesh, P(self.axis_name)))
             self._sharding_cache = cached
         return cached[1]
+
+    @staticmethod
+    def _container_source(
+        cont: "DistributedDataContainer",
+    ) -> tuple[Any, tuple[Any, int] | None]:
+        """Batch source + native-gather backing for the full-dataset-view
+        iteration orders (global_shuffle, elastic_order): `order` entries
+        are GLOBAL dataset indices, so the backing offset is 0."""
+        source = cont.data
+        backing = (
+            (source.arrays, 0) if isinstance(source, ArrayDataset) else None
+        )
+        return source, backing
 
     def _array_backing(self) -> tuple[Any, int] | None:
         """If the dataset is array-backed, return (array pytree, index
@@ -650,7 +838,35 @@ class DistributedDataLoader:
             yield queue.popleft()
 
     def _iter_batches(self) -> Iterator[Any]:
-        if self.global_shuffle:
+        if (
+            self.elastic_order
+            and jax.process_count() > 1
+            and isinstance(self.data, DistributedDataContainer)
+        ):  # pragma: no cover - multihost only
+            # Batch-major, topology-invariant assignment (class
+            # docstring): this process's epoch order is its contiguous
+            # local-batch slice of every whole global batch of the
+            # full-dataset order — so batch b holds the same global
+            # samples on any process count, and a remapped cursor names
+            # an exact prefix of the epoch.
+            cont = self.data
+            total = cont.total_size
+            if self.shuffle:
+                rng = np.random.default_rng(self.seed + self._epoch)
+                full_order = rng.permutation(total)
+            else:
+                full_order = np.arange(total)
+            lbs = self.local_batch_size
+            nfull = total // self.global_batch_size
+            order = (
+                full_order[: nfull * self.global_batch_size]
+                .reshape(nfull, jax.process_count(), lbs)[
+                    :, jax.process_index(), :
+                ]
+                .reshape(-1)
+            )
+            source, backing = self._container_source(cont)
+        elif self.global_shuffle:
             # Same seeded permutation of the FULL dataset on every process
             # (no communication); this process takes the contiguous slice
             # of the permutation matching its ceil-partition bounds, so
@@ -663,12 +879,7 @@ class DistributedDataLoader:
             # sizes (and the lockstep batch count) stay identical to the
             # fixed-shard layout by construction.
             order = perm[cont.idxs.start : cont.idxs.stop]
-            source = cont.data
-            backing = (
-                (source.arrays, 0)
-                if isinstance(source, ArrayDataset)
-                else None
-            )
+            source, backing = self._container_source(cont)
         else:
             source = self.data
             order = np.arange(len(source))

@@ -44,6 +44,16 @@ class FaultInjectedError(RuntimeError):
         )
 
 
+class TopologyMismatchError(ValueError):
+    """Raised when an elastic restore cannot lay a checkpointed leaf out
+    over the *current* mesh: a partition axis named by the saved (or
+    supplied) partition spec is absent from the mesh, or the leaf
+    dimension it shards is not divisible by the new axis size. The
+    message names the leaf path, the offending dimension/axis, and both
+    topologies so the operator can tell "resize the mesh" from "wrong
+    checkpoint family" (see docs/fault_tolerance.md, "Elastic resume")."""
+
+
 class CheckpointTimeoutError(RuntimeError):
     """Raised when a checkpoint save/wait exceeds the hard deadline set by
     ``FLUXMPI_TPU_CKPT_TIMEOUT`` — a background save wedged past the point

@@ -21,9 +21,15 @@ site                  where it fires
 ``ckpt.write``        each checkpoint write **attempt** (inside the retry
                       loop — ``times=2`` exercises two retries then
                       success)
-``ckpt.commit``       between the checkpoint rename and the COMMIT marker
+``ckpt.manifest``     between the checkpoint data rename and the topology
+                      manifest write (a crash there leaves a committed-
+                      looking dir with no manifest and no marker —
+                      quarantined at startup, previous step restorable)
+``ckpt.commit``       between the manifest write and the COMMIT marker
                       (simulates a crash that leaves an uncommitted step)
 ``ckpt.read``         :func:`~fluxmpi_tpu.utils.checkpoint.restore_checkpoint`
+``elastic.restore``   the explicit elastic restore path (``mesh=``/``rule=``
+                      template building, before any bytes move)
 ====================  =====================================================
 
 A firing site raises :class:`FaultInjectedError` (re-exported from

@@ -170,6 +170,22 @@ def train_loop(
         budgets: a run resumed at update 60 with ``steps=100`` runs 40
         more. Bumps the ``train.resumes`` counter.
 
+        Elastic resume (docs/fault_tolerance.md, "Elastic resume"): the
+        checkpoint's topology manifest is read first; when the world
+        changed — different process count, mesh axis sizes, or loader
+        global batch size — the banked loader cursor is remapped through
+        its global sample offset (sample-exact: the resumed epoch
+        consumes exactly the remaining samples; ragged remainders round
+        down with the re-seen count logged), budgets keep their
+        total-update/total-epoch meaning against the NEW per-epoch
+        dispatch count, and the labeled
+        ``train.resumes{topology_changed="true"}`` series ticks. The
+        caller builds ``state`` for the CURRENT topology as usual —
+        sharded leaves reshard through the manifest-validated orbax
+        path, replicated ones root-broadcast. A checkpoint written
+        before manifests existed resumes same-topology exactly as under
+        PR 5 (with a warning).
+
     Preemption: when the runtime's preemption flag is set
     (``init(preemption=True)`` installs the SIGTERM/SIGINT handler; see
     :func:`fluxmpi_tpu.runtime.request_preemption`), the loop notices at
@@ -265,7 +281,9 @@ def train_loop(
     is_loader = isinstance(batches, DistributedDataLoader)
     per_epoch = _epoch_len(batches, k)
 
-    def _payload(st: Any, *, pass_counted: bool = False) -> dict[str, Any]:
+    def _payload(
+        st: Any, *, pass_counted: bool = False, legacy_loader: bool = False
+    ) -> dict[str, Any]:
         # What a checkpoint banks: the TrainState plus everything the
         # loop needs to continue EXACTLY — cumulative counters and the
         # loader's (epoch, cursor) position. Scalars ride as int64
@@ -311,6 +329,15 @@ def train_loop(
             },
         }
         if loader_state is not None:
+            if not legacy_loader:
+                # Bank the batch geometry the cursor's meaning depends on
+                # next to the position, so an elastic resume under a
+                # different process count / global batch size can remap
+                # it (load_state_dict reads these keys; the save-time
+                # manifest records a copy). legacy_loader builds the
+                # PR 5 template shape for restoring pre-manifest
+                # checkpoints, whose banked loader dict has no geometry.
+                loader_state = {**loader_state, **batches.geometry()}
             payload["loader"] = {
                 key: np.asarray(val, np.int64)
                 for key, val in loader_state.items()
@@ -320,15 +347,54 @@ def train_loop(
     resumed_from = None
     resume_offset = 0  # dispatches already done in a resumed partial epoch
     if resume:
+        # The manifest (the topology sidecar every PR 6 save writes)
+        # tells us, BEFORE any bytes move, whether the checkpoint comes
+        # from a different world — and whether it predates manifests, in
+        # which case the restore template must use the PR 5 payload
+        # shape (no loader-geometry keys to miss).
+        manifest = None
+        read_manifest = getattr(checkpoint, "read_manifest", None)
+        if read_manifest is not None:
+            manifest = read_manifest()
         try:
-            ckpt_step, restored = checkpoint.restore(_payload(state))
+            ckpt_step, restored = checkpoint.restore(
+                _payload(state, legacy_loader=manifest is None)
+            )
         except FileNotFoundError:
             restored = None  # empty directory: fresh start, same command
+        except (TypeError, ValueError, KeyError):
+            # Structure-mismatch family only (what orbax raises when the
+            # template tree disagrees with the checkpoint) — injected
+            # faults (FaultInjectedError) and I/O errors must propagate,
+            # not trigger a blind second restore.
+            if manifest is not None:
+                raise
+            # No manifest does not prove a PR 5 payload: a PR 6
+            # checkpoint whose sidecar was lost/corrupted still banks
+            # the geometry-carrying loader dict, and the legacy template
+            # just mismatched its structure. Retry with the full shape
+            # before declaring the checkpoint unrestorable.
+            ckpt_step, restored = checkpoint.restore(_payload(state))
         if restored is not None:
             state = restored["state"]
             updates = int(restored["loop"]["updates"])
             examples = int(restored["loop"]["examples"])
             epochs_done = int(restored["loop"]["epochs"])
+            topology_changed = False
+            if manifest is not None:
+                from ..utils import manifest as _manifest_util
+
+                topology_changed = _manifest_util.topology_changed(
+                    manifest, mesh=getattr(batches, "mesh", None)
+                )
+                saved_geom = manifest.get("loader") or {}
+                if is_loader and saved_geom:
+                    geom = batches.geometry()
+                    topology_changed = topology_changed or any(
+                        key in saved_geom
+                        and int(saved_geom[key]) != geom[key]
+                        for key in ("process_count", "global_batch_size")
+                    )
             if is_loader and "loader" in restored:
                 batches.load_state_dict(
                     {key: int(val) for key, val in restored["loader"].items()}
@@ -337,12 +403,30 @@ def train_loop(
                 # (the banked epoch count already includes that pass —
                 # _payload's canonical form); what remains is mid-epoch
                 # dispatches already done.
+                if k > 1 and batches.resume_cursor % k:
+                    # An elastic remap can land mid-scan-group (same-
+                    # topology saves always sit at dispatch boundaries);
+                    # re-seat at the group boundary so the scan adapter's
+                    # grouping keeps the uninterrupted run's phase — the
+                    # few re-dispatched batches are the same round-down
+                    # contract as the remap itself.
+                    seat = batches.state_dict()
+                    seat["cursor"] = (batches.resume_cursor // k) * k
+                    batches.load_state_dict(seat)
                 resume_offset = batches.resume_cursor // k
             resumed_from = ckpt_step
             if record_metrics:
                 registry = _live_registry()
                 if registry is not None:
+                    # The unlabeled series counts every resume (the PR 5
+                    # contract); the labeled one counts the elastic
+                    # subset so dashboards can tell a plain restart from
+                    # a fleet resize.
                     registry.counter("train.resumes").inc()
+                    if topology_changed:
+                        registry.counter(
+                            "train.resumes", topology_changed="true"
+                        ).inc()
 
     last_saved = updates
     preempted = False

@@ -35,6 +35,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import config
+from ..errors import TopologyMismatchError
 
 __all__ = [
     "Rule",
@@ -44,6 +45,7 @@ __all__ = [
     "transformer_tp_rules",
     "tree_partition_specs",
     "shard_tree",
+    "validated_spec_strict",
 ]
 
 # A sharding rule: (leaf path like "encoder/block_0/ff1/kernel", leaf shape)
@@ -159,6 +161,43 @@ def transformer_tp_rules(tp_axis: str | None = None) -> Rule:
     )
 
 
+def _walk_spec(
+    spec: P | None, shape: tuple[int, ...], mesh: Mesh
+) -> tuple[list, list[tuple[str, int, Any, Any]]]:
+    """The one spec-vs-leaf traversal both validators share: pad the spec
+    to the leaf rank, expand str-vs-tuple axis groups, resolve sizes
+    against the mesh. Returns ``(entries, problems)`` — ``entries[d]`` is
+    the validated axis names for dim ``d`` (None where a problem forced
+    replication) and each problem is ``(kind, dim, names, detail)`` with
+    kind in {"rank", "missing", "indivisible"} (rank problems use dim -1
+    and empty entries). How a problem is acted on — warn-and-replicate at
+    model-build time, raise at restore time — is the callers' delta."""
+    if spec is None:
+        return [], []  # no opinion → P(), not P(None, ...): same layout,
+        # but the canonical spelling round-trips through manifests
+    if len(spec) > len(shape):
+        return [], [("rank", -1, tuple(spec), None)]
+    entries: list = []
+    problems: list[tuple[str, int, Any, Any]] = []
+    for d, names in enumerate(tuple(spec) + (None,) * (len(shape) - len(spec))):
+        if names is None:
+            entries.append(None)
+            continue
+        group = (names,) if isinstance(names, str) else tuple(names)
+        missing = [n for n in group if n not in mesh.shape]
+        if missing:
+            problems.append(("missing", d, names, missing[0]))
+            entries.append(None)
+            continue
+        size = int(np.prod([mesh.shape[n] for n in group]))
+        if shape[d] % size:
+            problems.append(("indivisible", d, names, size))
+            entries.append(None)
+        else:
+            entries.append(names)
+    return entries, problems
+
+
 def _validated(
     spec: P | None, shape: tuple[int, ...], mesh: Mesh, path: str = "<leaf>"
 ) -> P:
@@ -169,43 +208,63 @@ def _validated(
     ``.sharding`` by hand."""
     import warnings
 
-    if spec is None:
-        return P()
-    if len(spec) > len(shape):
-        warnings.warn(
-            f"sharding rule for {path!r} has spec {spec} with more dims than "
-            f"the leaf shape {shape}; leaf stays replicated",
-            stacklevel=3,
-        )
-        return P()
-    out = []
-    for d, names in enumerate(tuple(spec) + (None,) * (len(shape) - len(spec))):
-        if names is None:
-            out.append(None)
-            continue
-        group = (names,) if isinstance(names, str) else tuple(names)
-        missing = [n for n in group if n not in mesh.shape]
-        if missing:
-            warnings.warn(
-                f"sharding rule for {path!r} names mesh axis "
-                f"{missing[0]!r} absent from mesh axes "
-                f"{tuple(mesh.axis_names)}; dim {d} stays replicated",
-                stacklevel=3,
+    entries, problems = _walk_spec(spec, shape, mesh)
+    for kind, d, names, detail in problems:
+        if kind == "rank":
+            message = (
+                f"sharding rule for {path!r} has spec {spec} with more dims "
+                f"than the leaf shape {shape}; leaf stays replicated"
             )
-            out.append(None)
-            continue
-        size = int(np.prod([mesh.shape[n] for n in group]))
-        if shape[d] % size:
-            warnings.warn(
-                f"sharding rule for {path!r}: dim {d} of shape {shape} not "
-                f"divisible by axis {names!r} size {size}; dim stays "
-                f"replicated",
-                stacklevel=3,
+        elif kind == "missing":
+            message = (
+                f"sharding rule for {path!r} names mesh axis {detail!r} "
+                f"absent from mesh axes {tuple(mesh.axis_names)}; dim {d} "
+                f"stays replicated"
             )
-            out.append(None)
         else:
-            out.append(names)
-    return P(*out)
+            message = (
+                f"sharding rule for {path!r}: dim {d} of shape {shape} not "
+                f"divisible by axis {names!r} size {detail}; dim stays "
+                f"replicated"
+            )
+        warnings.warn(message, stacklevel=3)
+    return P(*entries)
+
+
+def validated_spec_strict(
+    spec: P | None, shape: tuple[int, ...], mesh: Mesh, path: str = "<leaf>"
+) -> P:
+    """Validate a spec against a leaf shape and mesh, raising
+    :class:`~fluxmpi_tpu.errors.TopologyMismatchError` instead of
+    degrading to replicated — the elastic-restore discipline: at restore
+    time a silently-replicated leaf would *load* fine and then blow
+    memory (or recompile) at the first step, so a layout the new
+    topology cannot express must fail loudly and name itself (see
+    docs/fault_tolerance.md, "Elastic resume"). :func:`_validated` (the
+    warn-and-replicate flavor) stays the right call at model-build time,
+    where the rule is a heuristic."""
+    entries, problems = _walk_spec(spec, shape, mesh)
+    for kind, d, names, detail in problems:
+        where = f"cannot restore {path!r} onto mesh axes {dict(mesh.shape)}"
+        if kind == "rank":
+            raise TopologyMismatchError(
+                f"{where}: partition spec {spec} has more dimensions than "
+                f"the saved leaf shape {shape}"
+            )
+        if kind == "missing":
+            raise TopologyMismatchError(
+                f"{where}: dimension {d} is partitioned over mesh axis "
+                f"{detail!r}, which the current mesh does not have — "
+                f"restore with a mesh that names it, or pass a partition "
+                f"rule for the new topology"
+            )
+        raise TopologyMismatchError(
+            f"{where}: dimension {d} of shape {shape} is not divisible by "
+            f"the {names!r} axis size {detail} — the saved layout does not "
+            f"fit this topology; resize the mesh or pass a partition rule "
+            f"that avoids the axis"
+        )
+    return P(*entries)
 
 
 def tree_partition_specs(tree: Any, mesh: Mesh, rule: Rule) -> Any:

@@ -55,6 +55,8 @@ SCHEMA = "fluxmpi_tpu.telemetry/v1"
 
 TRACE_SCHEMA = "fluxmpi_tpu.trace/v1"
 
+MANIFEST_SCHEMA = "fluxmpi_tpu.manifest/v1"
+
 METRIC_TYPES = ("counter", "gauge", "histogram")
 
 _HIST_STAT_KEYS = ("sum", "min", "max", "mean", "last")
@@ -217,6 +219,147 @@ def validate_bench_record(rec: object) -> list[str]:
             )
     if "mfu" in rec and _is_number(rec["mfu"]) and not 0 <= rec["mfu"] <= 1:
         errors.append(f"'mfu' out of range [0, 1]: {rec['mfu']!r}")
+    return errors
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint manifest (schema "fluxmpi_tpu.manifest/v1"): the topology
+# sidecar every save writes next to the commit marker — global leaf
+# shapes/dtypes/partition specs, the save-time mesh and process count,
+# the loader position + batch geometry, and the loop counters. Elastic
+# restore (docs/fault_tolerance.md, "Elastic resume") reads it to build
+# the resharding template; this validator is what
+# scripts/check_metrics_schema.py runs against manifest.json files.
+# ---------------------------------------------------------------------------
+
+MANIFEST_LAYOUTS = ("replicated", "sharded")
+
+# Loader-geometry keys an elastic resume needs (ints); the three position
+# keys are always present, the geometry keys ride along from PR 6 on.
+_MANIFEST_LOADER_REQUIRED = ("epoch", "cursor", "seed")
+_MANIFEST_LOADER_OPTIONAL = (
+    "global_batch_size",
+    "num_batches",
+    "process_count",
+    "elastic_order",
+)
+
+_MANIFEST_COUNTER_KEYS = ("updates", "examples", "epochs")
+
+
+def _is_int(x: object) -> bool:
+    return isinstance(x, int) and not isinstance(x, bool)
+
+
+def _validate_manifest_spec(spec: object, ndim: int, where: str) -> list[str]:
+    """One leaf's partition spec: null (replicated) or a per-dimension
+    list of null | axis name | list of axis names, no longer than the
+    leaf's rank."""
+    if spec is None:
+        return []
+    if not isinstance(spec, list):
+        return [f"{where}: 'spec' must be null or a list, got {spec!r}"]
+    errors: list[str] = []
+    if len(spec) > ndim:
+        errors.append(
+            f"{where}: 'spec' has {len(spec)} entries for a rank-{ndim} leaf"
+        )
+    for d, names in enumerate(spec):
+        if names is None or (isinstance(names, str) and names):
+            continue
+        if isinstance(names, list) and names and all(
+            isinstance(n, str) and n for n in names
+        ):
+            continue
+        errors.append(
+            f"{where}: spec[{d}] must be null, an axis name, or a "
+            f"non-empty list of axis names, got {names!r}"
+        )
+    return errors
+
+
+def validate_manifest(rec: object) -> list[str]:
+    """Validate a checkpoint manifest (schema "fluxmpi_tpu.manifest/v1");
+    returns a list of error strings (empty == valid)."""
+    if not isinstance(rec, dict):
+        return [f"manifest is not an object: {type(rec).__name__}"]
+    errors: list[str] = []
+    if rec.get("schema") != MANIFEST_SCHEMA:
+        errors.append(
+            f"'schema' must be {MANIFEST_SCHEMA!r}, got {rec.get('schema')!r}"
+        )
+    if not _is_number(rec.get("time_unix")):
+        errors.append("missing numeric 'time_unix'")
+    if rec.get("layout") not in MANIFEST_LAYOUTS:
+        errors.append(
+            f"'layout' must be one of {MANIFEST_LAYOUTS}, "
+            f"got {rec.get('layout')!r}"
+        )
+    if not _is_int(rec.get("process_count")) or rec["process_count"] < 1:
+        errors.append("'process_count' must be an int >= 1")
+    step = rec.get("step")
+    if step is not None and not _is_int(step):
+        errors.append("'step' must be an int or null")
+    mesh = rec.get("mesh")
+    if mesh is not None:
+        axes = mesh.get("axes") if isinstance(mesh, dict) else None
+        if not isinstance(axes, dict) or not axes or not all(
+            isinstance(k, str) and k and _is_int(v) and v >= 1
+            for k, v in axes.items()
+        ):
+            errors.append(
+                "'mesh' must be null or {'axes': {name: size >= 1, ...}}, "
+                f"got {mesh!r}"
+            )
+    leaves = rec.get("leaves")
+    if not isinstance(leaves, list):
+        errors.append("'leaves' must be a list")
+        leaves = []
+    seen_paths: set[str] = set()
+    for i, leaf in enumerate(leaves):
+        lw = f"leaves[{i}]"
+        if not isinstance(leaf, dict):
+            errors.append(f"{lw}: not an object")
+            continue
+        path = leaf.get("path")
+        if not isinstance(path, str) or not path:
+            errors.append(f"{lw}: missing/invalid 'path' (str)")
+        elif path in seen_paths:
+            errors.append(f"{lw}: duplicate leaf path {path!r}")
+        else:
+            seen_paths.add(path)
+        shape = leaf.get("shape")
+        if not isinstance(shape, list) or not all(
+            _is_int(d) and d >= 0 for d in shape
+        ):
+            errors.append(f"{lw}: 'shape' must be a list of ints >= 0")
+            shape = []
+        if not isinstance(leaf.get("dtype"), str) or not leaf.get("dtype"):
+            errors.append(f"{lw}: missing/invalid 'dtype' (str)")
+        errors.extend(
+            _validate_manifest_spec(leaf.get("spec"), len(shape), lw)
+        )
+    loader = rec.get("loader")
+    if loader is not None:
+        if not isinstance(loader, dict):
+            errors.append(f"'loader' must be null or an object, got {loader!r}")
+        else:
+            for key in _MANIFEST_LOADER_REQUIRED:
+                if not _is_int(loader.get(key)):
+                    errors.append(f"loader: missing int {key!r}")
+            for key in _MANIFEST_LOADER_OPTIONAL:
+                if key in loader and not _is_int(loader[key]):
+                    errors.append(f"loader: {key!r} must be an int")
+    counters = rec.get("counters")
+    if counters is not None:
+        if not isinstance(counters, dict):
+            errors.append(
+                f"'counters' must be null or an object, got {counters!r}"
+            )
+        else:
+            for key in _MANIFEST_COUNTER_KEYS:
+                if not _is_int(counters.get(key)):
+                    errors.append(f"counters: missing int {key!r}")
     return errors
 
 

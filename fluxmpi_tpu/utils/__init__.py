@@ -5,6 +5,14 @@ from .checkpoint import (  # noqa: F401
     restore_checkpoint,
     save_checkpoint,
 )
+from .manifest import (  # noqa: F401
+    MANIFEST_SCHEMA,
+    build_manifest,
+    manifest_path,
+    read_manifest,
+    validate_manifest,
+    write_manifest,
+)
 from .profiling import profile_trace, step_timer  # noqa: F401
 from .ema import EMAState, ema_init, ema_params, ema_update  # noqa: F401
 from .precision import (  # noqa: F401

@@ -36,6 +36,17 @@ Multi-process contract: the checkpoint path must live on storage
 commit marker, discovery, startup quarantine, and the peer-failure abort
 sentinels all read the filesystem at the path, so a per-host local disk
 would leave non-lead processes blind to commits and aborts alike.
+
+Elasticity (PR 6, docs/fault_tolerance.md "Elastic resume"): every save
+also writes a schema-validated ``<path>.manifest.json``
+(:mod:`fluxmpi_tpu.utils.manifest`) recording global leaf
+shapes/dtypes/partition specs, the save-time mesh and process count, and
+— for ``train_loop`` payloads — the loader position + batch geometry.
+``restore_checkpoint(..., mesh=..., rule=...)`` uses it to build the
+resharding template internally, so a checkpoint written on N hosts
+restores on M without a like-tree from the old world; the manifest is
+written between the data rename and the commit marker (fault site
+``ckpt.manifest``), so every *committed* step has one.
 """
 
 from __future__ import annotations
@@ -61,6 +72,7 @@ from ..errors import CheckpointDesyncError, CheckpointTimeoutError
 from ..errors import FaultInjectedError
 from ..sync import synchronize
 from ..telemetry import get_registry as _telemetry_registry
+from . import manifest as _manifest
 
 __all__ = ["save_checkpoint", "restore_checkpoint", "CheckpointManager"]
 
@@ -317,7 +329,109 @@ def _restore_sharded(path: str, like: Any) -> Any:
     return ocp.StandardCheckpointer().restore(path, _sds_template(like))
 
 
-def save_checkpoint(path: str, state: Any, *, force: bool = True) -> None:
+def _to_host_template(tree: Any) -> Any:
+    """Concrete host-numpy twin of ``tree``: device arrays come back to
+    host, abstract :class:`jax.ShapeDtypeStruct` leaves materialize as
+    zeros — a concrete ``item=`` template is the one every orbax version
+    accepts (values are overwritten by the checkpoint bytes)."""
+
+    def leaf(x: Any) -> Any:
+        if isinstance(x, jax.ShapeDtypeStruct):
+            return np.zeros(x.shape, x.dtype)
+        if isinstance(x, (jax.Array, np.ndarray)):
+            return np.asarray(jax.device_get(x))
+        return x
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
+def _place_into(restored: Any, targets: Any) -> Any:
+    """Lay restored host values out like ``targets`` (concrete arrays or
+    sharding-carrying ShapeDtypeStructs), refusing silent shape
+    mismatches — restoring a (2,) kernel into a (3,) slot must fail
+    loudly, not produce a corrupted state. The ONE placement helper for
+    both the plain-replicated and elastic restore paths."""
+
+    def _place(r: Any, t: Any) -> Any:
+        if not isinstance(t, (jax.Array, jax.ShapeDtypeStruct)):
+            return r
+        r_arr = np.asarray(r, dtype=t.dtype)
+        if r_arr.shape != tuple(t.shape):
+            raise ValueError(
+                f"checkpoint leaf shape {r_arr.shape} does not match "
+                f"expected {tuple(t.shape)}"
+            )
+        return jax.device_put(r_arr, t.sharding)
+
+    return jax.tree_util.tree_map(_place, restored, targets)
+
+
+# One warning per checkpoint path per process lifetime (lead process
+# only): these fire on every restore of an old checkpoint otherwise, and
+# a resuming fleet restores once per process.
+_warned_missing_manifest: set[str] = set()
+_warned_missing_marker: set[str] = set()
+
+
+def _warn_once(cache: set[str], path: str, message: str) -> None:
+    if jax.process_index() != 0 or path in cache:
+        return
+    cache.add(path)
+    warnings.warn(message, stacklevel=4)
+
+
+def _restore_elastic(
+    path: str,
+    like: Any,
+    man: dict[str, Any] | None,
+    mesh: Any,
+    rule: Any,
+    root_rank: int,
+) -> Any:
+    """Explicit elastic restore (``mesh=``/``rule=`` passed): build the
+    sharding-carrying template for the CURRENT topology internally —
+    from the rule when given, else from the partition specs the manifest
+    banked at save time — and land every leaf directly in its new
+    layout. Sharded checkpoints reshard through orbax (N→M, no host
+    gather); replicated checkpoints take the load-on-root + broadcast
+    path and are then placed into the target shardings."""
+    if _faults.ARMED:
+        _faults.check("elastic.restore")
+    if mesh is None:
+        from ..runtime import global_mesh
+
+        mesh = global_mesh()
+    if man is not None:
+        _manifest.check_manifest_shapes(man, like)
+    elif rule is None:
+        raise ValueError(
+            f"elastic restore of {path} without a partition rule needs the "
+            f"checkpoint manifest to know the saved partition specs, and "
+            f"this checkpoint has none (written before elastic "
+            f"checkpoints) — pass rule= for the new topology, or restore "
+            f"with a like tree already carrying the target shardings"
+        )
+    layout = man["layout"] if man is not None else _read_layout_marker(path)
+    if layout is None:
+        layout = "sharded" if _is_sharded_tree(like) else "replicated"
+    template = _manifest.sharded_template(like, man, mesh, rule)
+    if layout == "sharded":
+        return _restore_sharded(path, template)
+    # Replicated checkpoint, explicit target layout: read host bytes via
+    # the root-broadcast path (concrete host template: safe on every
+    # orbax version, SDS leaves in `like` included), then device_put
+    # each leaf into its new sharding — a host→device reshard needs no
+    # orbax involvement.
+    synced = synchronize(
+        _checkpointer().restore(path, item=_to_host_template(like)),
+        root_rank=root_rank,
+    )
+    return _place_into(synced, template)
+
+
+def save_checkpoint(
+    path: str, state: Any, *, force: bool = True, step: int | None = None
+) -> None:
     """Write ``state`` (any pytree, e.g. a TrainState) to ``path``.
 
     Only the lead process writes replicated DP state (identical
@@ -326,11 +440,16 @@ def save_checkpoint(path: str, state: Any, *, force: bool = True) -> None:
     so the flow is SPMD-safe.
 
     Crash-consistent: bytes land in ``<path>.tmp``, which is renamed to
-    ``path`` and only then committed by the fsync'd layout marker — a
-    crash anywhere in between leaves an uncommitted directory that
-    discovery skips and :class:`CheckpointManager` quarantines at
-    startup. Transient write failures retry with capped exponential
-    backoff (env knobs in the module docstring).
+    ``path``, described by the ``<path>.manifest.json`` topology manifest
+    (lead process; the elastic-restore sidecar, see
+    :mod:`fluxmpi_tpu.utils.manifest`), and only then committed by the
+    fsync'd layout marker — a crash anywhere in between leaves an
+    uncommitted directory that discovery skips and
+    :class:`CheckpointManager` quarantines at startup, so every committed
+    step has its manifest. Transient write failures retry with capped
+    exponential backoff (env knobs in the module docstring). ``step``
+    (optional) is recorded in the manifest — :class:`CheckpointManager`
+    passes its step number.
     """
     path = os.path.abspath(path)
     layout = "sharded" if _is_sharded_tree(state) else "replicated"
@@ -367,12 +486,7 @@ def save_checkpoint(path: str, state: Any, *, force: bool = True) -> None:
             # multihost coordination barriers require all participants;
             # orbax's primary-host logic ensures only the lead process
             # actually writes the replicated bytes.
-            host_state = jax.tree_util.tree_map(
-                lambda x: np.asarray(jax.device_get(x))
-                if isinstance(x, (jax.Array, np.ndarray))
-                else x,
-                state,
-            )
+            host_state = _to_host_template(state)
             _with_write_retries(
                 lambda: _checkpointer().save(tmp, host_state, force=True),
                 f"checkpoint write to {tmp}",
@@ -431,6 +545,8 @@ def save_checkpoint(path: str, state: Any, *, force: bool = True) -> None:
         os.remove(marker)
     except FileNotFoundError:
         pass
+    with contextlib.suppress(FileNotFoundError, OSError):
+        os.remove(_manifest.manifest_path(path))
     shutil.rmtree(path, ignore_errors=True)
     _process_barrier(f"ckpt_decommit:{path}")  # removals land pre-rename
     # Rename on EVERY process that sees a staging dir: the first rename
@@ -447,9 +563,38 @@ def save_checkpoint(path: str, state: Any, *, force: bool = True) -> None:
     # _fsync_dir: without this a post-return power cut could keep the
     # decommit but lose the rename).
     _fsync_dir(os.path.dirname(path))
-    if lead and _faults.ARMED:
-        # The crash-between-rename-and-commit window, injectable.
-        _faults.check("ckpt.commit")
+    if lead:
+        if _faults.ARMED:
+            # The crash-between-data-commit-and-manifest window,
+            # injectable: the renamed dir exists but carries no manifest
+            # (and no marker — still uncommitted, quarantined at startup).
+            _faults.check("ckpt.manifest")
+        # The topology sidecar rides BEFORE the commit marker so a
+        # committed step always has its manifest; built from the original
+        # `state` (not the host copy) so sharded leaves keep their specs.
+        # A sidecar write failure must NOT abort the save: this runs
+        # between barriers on the lead only, so raising here would
+        # strand every peer at ckpt_commit — and the checkpoint is
+        # complete without it (restore degrades to the topology-blind
+        # path with a warning). Only the injected chaos crash
+        # propagates: it simulates the process dying, not an I/O error.
+        try:
+            _manifest.write_manifest(
+                path,
+                _manifest.build_manifest(state, layout=layout, step=step),
+            )
+        except (OSError, ValueError) as exc:
+            warnings.warn(
+                f"could not write the topology manifest beside {path} "
+                f"({exc!r}); committing the checkpoint WITHOUT it — "
+                f"elastic (cross-topology) restore of this step will "
+                f"need an explicit rule, same-topology restore is "
+                f"unaffected",
+                stacklevel=2,
+            )
+        if _faults.ARMED:
+            # The crash-between-rename-and-commit window, injectable.
+            _faults.check("ckpt.commit")
     _process_barrier(f"ckpt_commit:{path}")  # every rename lands first
     if lead:
         _write_layout_marker(path, layout)
@@ -462,6 +607,8 @@ def restore_checkpoint(
     *,
     root_rank: int = 0,
     allow_layout_change: bool = False,
+    mesh: Any = None,
+    rule: Any = None,
 ) -> Any:
     """Read the checkpoint at ``path`` and return it synchronized from
     ``root_rank`` and laid out like ``like`` (replicated over the mesh).
@@ -473,22 +620,64 @@ def restore_checkpoint(
     its training sharding — no host gather, no broadcast needed (the
     checkpoint bytes are the single source, so root_rank is moot).
 
-    Elastic restore: a sharded checkpoint restores onto a DIFFERENT mesh
-    topology whenever ``like`` carries the target shardings (orbax
-    reshards on read) — e.g. resume a pod run on a smaller slice. Crossing
-    the replicated↔sharded *layout family* (e.g. inspecting a pod FSDP
-    checkpoint fully replicated on one host) is usually an accident, so
-    the layout marker rejects it unless ``allow_layout_change=True``.
+    Elastic restore (docs/fault_tolerance.md, "Elastic resume"): a
+    sharded checkpoint restores onto a DIFFERENT mesh topology whenever
+    ``like`` carries the target shardings (orbax reshards on read) — and
+    with ``mesh=`` (and optionally ``rule=``, a
+    :data:`~fluxmpi_tpu.parallel.sharding.Rule`) the target shardings
+    are built *internally*: ``like`` only provides structure and global
+    shapes (host arrays are fine), the layout comes from the rule or
+    from the partition specs the save-time manifest banked, re-validated
+    against the new mesh — a leaf the new topology cannot express raises
+    :class:`~fluxmpi_tpu.errors.TopologyMismatchError` naming it.
+    Crossing the replicated↔sharded *layout family* without an explicit
+    ``mesh=``/``rule=`` (e.g. inspecting a pod FSDP checkpoint fully
+    replicated on one host) is usually an accident, so the layout marker
+    rejects it unless ``allow_layout_change=True``.
     """
     if _faults.ARMED:
         _faults.check("ckpt.read")
     path = os.path.abspath(path)
+    man = _manifest.read_manifest(path)
+    if man is None:
+        _warn_once(
+            _warned_missing_manifest,
+            path,
+            f"checkpoint at {path} has no topology manifest (it predates "
+            f"elastic checkpoints); restoring the topology-blind way — "
+            f"same-topology restores are unaffected, but a cross-topology "
+            f"restore needs the like tree to carry the target shardings",
+        )
+    if mesh is not None or rule is not None:
+        return _restore_elastic(path, like, man, mesh, rule, root_rank)
     if _is_sharded_tree(like):
         if not allow_layout_change:
             _check_layout(path, "sharded")
+        elif _read_layout_marker(path) is None:
+            _warn_once(
+                _warned_missing_marker,
+                path,
+                f"checkpoint at {path} has no layout marker (it predates "
+                f"layout markers, or the save never committed); "
+                f"allow_layout_change=True cannot tell an old checkpoint "
+                f"from a wrong-family one here — verify the source run",
+            )
+        if man is not None:
+            _manifest.check_manifest_shapes(man, like)
         return _restore_sharded(path, like)
     if not allow_layout_change:
         _check_layout(path, "replicated")
+    elif _read_layout_marker(path) is None:
+        _warn_once(
+            _warned_missing_marker,
+            path,
+            f"checkpoint at {path} has no layout marker (it predates "
+            f"layout markers, or the save never committed); "
+            f"allow_layout_change=True cannot tell an old checkpoint "
+            f"from a wrong-family one here — verify the source run",
+        )
+    if man is not None:
+        _manifest.check_manifest_shapes(man, like)
     # The restore template only needs structure/shape/dtype — avoid pulling
     # the whole live state to host just to describe it.
     try:
@@ -507,32 +696,10 @@ def restore_checkpoint(
         # a concrete-host-array template (same-topology restores only reach
         # here). Genuine restore errors (missing or corrupt checkpoint) raise
         # other exception types and propagate.
-        restored = _checkpointer().restore(
-            path,
-            item=jax.tree_util.tree_map(
-                lambda x: np.asarray(jax.device_get(x))
-                if isinstance(x, (jax.Array, np.ndarray))
-                else x,
-                like,
-            ),
-        )
-    synced = synchronize(restored, root_rank=root_rank)
-
-    # Match leaf types/placement of `like` (replicated jax arrays), refusing
-    # silent shape mismatches — restoring a (2,) kernel into a (3,) slot
-    # must fail loudly, not produce a corrupted state.
-    def _place(r, l):
-        if isinstance(l, jax.Array):
-            r_arr = jax.numpy.asarray(r, dtype=l.dtype)
-            if r_arr.shape != l.shape:
-                raise ValueError(
-                    f"checkpoint leaf shape {r_arr.shape} does not match "
-                    f"expected {l.shape}"
-                )
-            return jax.device_put(r_arr, l.sharding)
-        return r
-
-    return jax.tree_util.tree_map(_place, synced, like)
+        restored = _checkpointer().restore(path, item=_to_host_template(like))
+    # Match leaf types/placement of `like` (replicated jax arrays) via the
+    # shared shape-refusing placement helper.
+    return _place_into(synchronize(restored, root_rank=root_rank), like)
 
 
 _STEP_DIR_RE = re.compile(r"^step_(\d{8})$")
@@ -615,6 +782,10 @@ class CheckpointManager:
             qdir = os.path.join(self.directory, "_quarantine")
             for name in sorted(os.listdir(self.directory)):
                 full = os.path.join(self.directory, name)
+                if not os.path.exists(full):
+                    # Moved along with its step dir earlier this sweep
+                    # (a partial dir's manifest sibling).
+                    continue
                 partial = os.path.isdir(full) and (
                     name.endswith(".tmp")
                     or (
@@ -626,9 +797,13 @@ class CheckpointManager:
                     name.endswith(".fluxmpi_layout")
                     and not os.path.isdir(full[: -len(".fluxmpi_layout")])
                 )
-                if orphan_marker:
-                    # A marker whose directory vanished (crash mid-
-                    # retention): committed-looking but unrestorable.
+                orphan_manifest = (
+                    name.endswith(".manifest.json")
+                    and not os.path.isdir(full[: -len(".manifest.json")])
+                )
+                if orphan_marker or orphan_manifest:
+                    # A marker/manifest whose directory vanished (crash
+                    # mid-retention): committed-looking but unrestorable.
                     os.remove(full)
                     removed.append(name)
                     continue
@@ -642,6 +817,13 @@ class CheckpointManager:
                     target = os.path.join(qdir, f"{name}.{suffix}")
                 os.rename(full, target)
                 moved.append(name)
+                # A crash in the manifest→marker window leaves the
+                # uncommitted dir WITH its manifest — the sidecar belongs
+                # to the quarantined artifact, so it moves along quietly
+                # (it is part of `name`, not a separate finding).
+                sibling = _manifest.manifest_path(full)
+                if os.path.exists(sibling):
+                    os.rename(sibling, target + ".manifest.json")
             if moved or removed:
                 parts = []
                 if moved:
@@ -651,8 +833,8 @@ class CheckpointManager:
                     )
                 if removed:
                     parts.append(
-                        f"removed {len(removed)} orphan commit "
-                        f"marker(s): {removed}"
+                        f"removed {len(removed)} orphan commit-marker/"
+                        f"manifest file(s): {removed}"
                     )
                 warnings.warn(
                     "; ".join(parts) + " — a previous run crashed "
@@ -728,12 +910,7 @@ class CheckpointManager:
             self.wait_until_finished()
             self._save_and_retain(step, state, force)
             return
-        snapshot = jax.tree_util.tree_map(
-            lambda x: np.asarray(jax.device_get(x))
-            if isinstance(x, (jax.Array, np.ndarray))
-            else x,
-            state,
-        )
+        snapshot = _to_host_template(state)
         # Submit under the lock so wait_until_finished always observes the
         # newest pending future; the single-worker executor runs saves in
         # submission order regardless. The wait on the *previous* save
@@ -751,7 +928,7 @@ class CheckpointManager:
             _wait_with_diagnostic(prev, "previous async checkpoint save")
 
     def _save_and_retain(self, step: int, state: Any, force: bool) -> None:
-        save_checkpoint(self._step_path(step), state, force=force)
+        save_checkpoint(self._step_path(step), state, force=force, step=step)
         if self.max_to_keep is not None:
             keep = set(self.all_steps()[-self.max_to_keep:])
             keep.add(step)
@@ -761,11 +938,14 @@ class CheckpointManager:
                         path = self._step_path(s)
                         # Marker first: once it is gone the step is
                         # invisible to discovery even if the rmtree below
-                        # is interrupted.
+                        # is interrupted (the startup sweep then collects
+                        # the leftover dir and manifest).
                         try:
                             os.remove(_layout_marker_path(path))
                         except FileNotFoundError:
                             pass
+                        with contextlib.suppress(FileNotFoundError, OSError):
+                            os.remove(_manifest.manifest_path(path))
                         shutil.rmtree(path, ignore_errors=True)
 
     def wait_until_finished(self) -> None:
@@ -776,17 +956,32 @@ class CheckpointManager:
         if pending is not None:
             _wait_with_diagnostic(pending, "in-flight async checkpoint save")
 
+    def read_manifest(self, step: int | None = None) -> dict[str, Any] | None:
+        """The topology manifest of ``step`` (default: latest complete
+        checkpoint), or None when the step has no valid manifest — a
+        checkpoint written before elastic checkpoints, or nothing saved
+        yet. See :mod:`fluxmpi_tpu.utils.manifest`."""
+        self.wait_until_finished()
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                return None
+        return _manifest.read_manifest(self._step_path(step))
+
     def restore(
         self,
         like: Any,
         *,
         step: int | None = None,
         allow_layout_change: bool = False,
+        mesh: Any = None,
+        rule: Any = None,
     ) -> tuple[int, Any]:
         """Restore ``step`` (default: latest complete) as
         ``(step, state)``; raises ``FileNotFoundError`` when nothing is
-        restorable. ``allow_layout_change`` forwards to
-        :func:`restore_checkpoint` (elastic cross-family restore)."""
+        restorable. ``allow_layout_change``, ``mesh`` and ``rule``
+        forward to :func:`restore_checkpoint` (elastic cross-family /
+        cross-topology restore)."""
         self.wait_until_finished()
         if step is None:
             step = self.latest_step()
@@ -797,6 +992,7 @@ class CheckpointManager:
         return step, restore_checkpoint(
             self._step_path(step), like,
             allow_layout_change=allow_layout_change,
+            mesh=mesh, rule=rule,
         )
 
     def close(self) -> None:

@@ -1,0 +1,386 @@
+"""Checkpoint manifests: the topology sidecar of every save.
+
+PR 5's checkpoints are bit-exact but mute about what they contain: a
+restore needs a live ``like`` tree from the *saving* topology to know
+what the bytes mean, so a run preempted on N hosts could only resume on
+N hosts. The manifest fixes that: every :func:`~.checkpoint.save_checkpoint`
+writes a schema-validated ``<path>.manifest.json`` next to the commit
+marker recording
+
+- the **global** shape/dtype and partition spec of every array leaf,
+- the mesh axis names/sizes and controller process count at save time,
+- the loader position *plus batch geometry* and the loop counters when
+  the saved tree is a ``train_loop`` payload.
+
+Restore then builds the resharding template internally: given the
+manifest plus the *current* mesh (and optionally a partition rule from
+:mod:`fluxmpi_tpu.parallel.sharding`), :func:`sharded_template` lays
+every leaf out over the new topology and orbax reshards on read — N→M
+for sharded (FSDP/TP) state, with
+:class:`~fluxmpi_tpu.errors.TopologyMismatchError` naming any leaf the
+new mesh cannot express. The schema (``fluxmpi_tpu.manifest/v1``) and
+its stdlib-only validator live in :mod:`fluxmpi_tpu.telemetry.schema`
+so ``scripts/check_metrics_schema.py`` validates manifests without
+booting jax. See docs/fault_tolerance.md, "Elastic resume".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import warnings
+from typing import Any
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..telemetry.schema import (
+    MANIFEST_SCHEMA,
+    _MANIFEST_LOADER_OPTIONAL,
+    _MANIFEST_LOADER_REQUIRED,
+    validate_manifest,
+)
+
+__all__ = [
+    "MANIFEST_SCHEMA",
+    "manifest_path",
+    "build_manifest",
+    "write_manifest",
+    "read_manifest",
+    "validate_manifest",
+    "sharded_template",
+    "check_manifest_shapes",
+    "mesh_axes",
+    "topology_changed",
+]
+
+_SUFFIX = ".manifest.json"
+
+
+def manifest_path(path: str) -> str:
+    """Sibling of the checkpoint directory (never inside it: orbax
+    interprets directory contents as checkpoint tree entries), mirroring
+    the layout-marker placement."""
+    return path.rstrip(os.sep) + _SUFFIX
+
+
+def _path_str(path: Any) -> str:
+    """Key-path → stable string key, same spelling as
+    :mod:`fluxmpi_tpu.parallel.sharding` rules use (``a/b/0/kernel``)."""
+    parts = []
+    for entry in path:
+        if hasattr(entry, "key"):
+            parts.append(str(entry.key))
+        elif hasattr(entry, "name"):
+            parts.append(str(entry.name))
+        elif hasattr(entry, "idx"):
+            parts.append(str(entry.idx))
+        else:  # pragma: no cover - future jax key types
+            parts.append(str(entry))
+    return "/".join(parts)
+
+
+def _encode_spec(spec: Any) -> list | None:
+    """PartitionSpec → JSON (per-dim: null | axis | [axes]); None for
+    "no layout opinion" (host arrays, unknown sharding kinds)."""
+    if spec is None:
+        return None
+    out: list = []
+    for names in tuple(spec):
+        if names is None:
+            out.append(None)
+        elif isinstance(names, str):
+            out.append(names)
+        else:
+            out.append([str(n) for n in names])
+    return out
+
+
+def decode_spec(encoded: list | None) -> P:
+    """JSON spec entry → :class:`~jax.sharding.PartitionSpec`
+    (``None`` decodes to fully replicated)."""
+    if encoded is None:
+        return P()
+    dims: list = []
+    for names in encoded:
+        if names is None or isinstance(names, str):
+            dims.append(names)
+        else:
+            dims.append(tuple(names))
+    return P(*dims)
+
+
+def _leaf_info(leaf: Any) -> tuple[tuple[int, ...], str, list | None] | None:
+    """(global shape, dtype name, encoded spec) for an array-like leaf;
+    None for opaque leaves (strings, callables, ...) which the manifest
+    skips — restore keeps whatever the template carries for those.
+    :class:`jax.ShapeDtypeStruct` counts as array-like: an abstract
+    ``like`` tree is the natural spelling of "structure and global
+    shapes only" on the elastic restore path."""
+    if isinstance(leaf, (jax.Array, jax.ShapeDtypeStruct)):
+        sharding = getattr(leaf, "sharding", None)
+        spec = (
+            _encode_spec(sharding.spec)
+            if isinstance(sharding, NamedSharding)
+            else None
+        )
+        return tuple(leaf.shape), np.dtype(leaf.dtype).name, spec
+    try:
+        arr = np.asarray(leaf)
+    except Exception:
+        return None
+    if arr.dtype == object:
+        return None
+    return tuple(arr.shape), arr.dtype.name, None
+
+
+def mesh_axes(mesh: Mesh | None) -> dict[str, int] | None:
+    """Mesh → ordered ``{axis: size}`` (None passes through)."""
+    if mesh is None:
+        return None
+    return {str(name): int(size) for name, size in mesh.shape.items()}
+
+
+def _tree_mesh(tree: Any) -> Mesh | None:
+    """The mesh named by the tree's own shardings, else the runtime's
+    global mesh, else None (uninitialized host-only trees)."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if isinstance(leaf, jax.Array) and isinstance(
+            leaf.sharding, NamedSharding
+        ):
+            return leaf.sharding.mesh
+    try:
+        from ..runtime import global_mesh
+
+        return global_mesh()
+    except Exception:
+        return None
+
+
+def _scalar_int(x: Any) -> int | None:
+    try:
+        arr = np.asarray(x)
+    except Exception:
+        return None
+    if arr.shape != () or not np.issubdtype(arr.dtype, np.integer):
+        return None
+    return int(arr)
+
+
+def _int_section(tree: Any, section: str) -> dict[str, int] | None:
+    """Hoist a ``train_loop`` payload section (``loader`` / ``loop``) of
+    scalar-int leaves into plain manifest ints; None when the saved tree
+    is not a payload (ad-hoc saves carry no position metadata)."""
+    if not isinstance(tree, dict):
+        return None
+    sub = tree.get(section)
+    if not isinstance(sub, dict) or not sub:
+        return None
+    out: dict[str, int] = {}
+    for key, val in sub.items():
+        as_int = _scalar_int(val)
+        if as_int is None:
+            return None
+        out[str(key)] = as_int
+    return out
+
+
+def build_manifest(
+    state: Any,
+    *,
+    layout: str,
+    step: int | None = None,
+    mesh: Mesh | None = None,
+) -> dict[str, Any]:
+    """Describe ``state`` (any pytree about to be checkpointed) as a
+    ``fluxmpi_tpu.manifest/v1`` record. ``layout`` is the save layout
+    (``"replicated"``/``"sharded"``, what the commit marker records);
+    ``step`` the manager's step number when saved through one."""
+    leaves = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        info = _leaf_info(leaf)
+        if info is None:
+            continue
+        shape, dtype, spec = info
+        leaves.append(
+            {
+                "path": _path_str(path),
+                "shape": [int(d) for d in shape],
+                "dtype": dtype,
+                "spec": spec,
+            }
+        )
+    counters = _int_section(state, "loop")
+    loop_keys = ("updates", "examples", "epochs")
+    if counters is not None and sorted(counters) != sorted(loop_keys):
+        counters = None
+    loader = _int_section(state, "loader")
+    if loader is not None and not (
+        all(key in loader for key in _MANIFEST_LOADER_REQUIRED)
+        and set(loader)
+        <= set(_MANIFEST_LOADER_REQUIRED + _MANIFEST_LOADER_OPTIONAL)
+    ):
+        # An ad-hoc user tree with a loader-SHAPED int section is not a
+        # train_loop payload; recording it would fail schema validation
+        # and cost the whole sidecar (leaf specs included). Same guard
+        # the counters section gets above.
+        loader = None
+    manifest_mesh = mesh if mesh is not None else _tree_mesh(state)
+    return {
+        "schema": MANIFEST_SCHEMA,
+        "time_unix": time.time(),
+        "step": int(step) if step is not None else None,
+        "layout": layout,
+        "process_count": jax.process_count(),
+        "mesh": (
+            {"axes": mesh_axes(manifest_mesh)}
+            if manifest_mesh is not None
+            else None
+        ),
+        "leaves": leaves,
+        "loader": loader,
+        "counters": counters,
+    }
+
+
+def write_manifest(path: str, manifest: dict[str, Any]) -> None:
+    """Write (fsync'd) the manifest beside the checkpoint at ``path``.
+    Validates first: a save must never commit a manifest a later restore
+    would reject."""
+    errors = validate_manifest(manifest)
+    if errors:
+        raise ValueError(
+            f"refusing to write an invalid checkpoint manifest for {path}: "
+            + "; ".join(errors)
+        )
+    target = manifest_path(path)
+    with open(target, "w", encoding="utf-8") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def read_manifest(path: str) -> dict[str, Any] | None:
+    """Read and validate the manifest beside the checkpoint at ``path``.
+    Returns None when absent (pre-elastic checkpoint — callers degrade
+    to topology-blind behavior) or invalid (warned, never a crash: a
+    corrupt sidecar must not brick a restorable checkpoint)."""
+    target = manifest_path(path)
+    try:
+        with open(target, encoding="utf-8") as f:
+            manifest = json.load(f)
+    except FileNotFoundError:
+        return None
+    except (OSError, json.JSONDecodeError) as exc:
+        warnings.warn(
+            f"checkpoint manifest at {target} is unreadable ({exc!r}); "
+            f"ignoring it — restore degrades to the topology-blind path",
+            stacklevel=2,
+        )
+        return None
+    errors = validate_manifest(manifest)
+    if errors:
+        warnings.warn(
+            f"checkpoint manifest at {target} fails schema validation "
+            f"({'; '.join(errors[:3])}); ignoring it — restore degrades to "
+            f"the topology-blind path",
+            stacklevel=2,
+        )
+        return None
+    return manifest
+
+
+def _leaves_by_path(manifest: dict[str, Any]) -> dict[str, dict[str, Any]]:
+    return {leaf["path"]: leaf for leaf in manifest.get("leaves", [])}
+
+
+def check_manifest_shapes(manifest: dict[str, Any], like: Any) -> None:
+    """Refuse a restore whose template disagrees with the manifest about
+    any leaf's *global* shape — the shape of a leaf is topology-invariant
+    (specs are not), so a mismatch means wrong checkpoint family, and the
+    error can name the leaf before any bytes move."""
+    by_path = _leaves_by_path(manifest)
+    for path, leaf in jax.tree_util.tree_flatten_with_path(like)[0]:
+        info = _leaf_info(leaf)
+        if info is None:
+            continue
+        entry = by_path.get(_path_str(path))
+        if entry is None:
+            continue
+        shape = tuple(entry["shape"])
+        if tuple(info[0]) != shape:
+            raise ValueError(
+                f"checkpoint leaf {_path_str(path)!r} shape {shape} (from "
+                f"the manifest) does not match expected {tuple(info[0])} — "
+                f"wrong checkpoint for this model/optimizer"
+            )
+
+
+def sharded_template(
+    like: Any,
+    manifest: dict[str, Any] | None,
+    mesh: Mesh,
+    rule: Any = None,
+) -> Any:
+    """Build the elastic restore template: ``like``'s structure with every
+    array leaf replaced by a :class:`jax.ShapeDtypeStruct` carrying a
+    :class:`~jax.sharding.NamedSharding` over the *current* ``mesh``.
+
+    Layout source, per leaf: an explicit ``rule`` (a
+    :data:`fluxmpi_tpu.parallel.sharding.Rule`) wins; otherwise the
+    partition spec the manifest recorded at save time, re-validated
+    against the new mesh — same axis names, new sizes. Validation is
+    strict: an axis the new mesh lacks, or a dimension its size no
+    longer divides, raises
+    :class:`~fluxmpi_tpu.errors.TopologyMismatchError` naming the leaf
+    (never a silent fall-back to replicated)."""
+    from ..parallel.sharding import validated_spec_strict
+
+    by_path = _leaves_by_path(manifest) if manifest is not None else {}
+
+    def leaf_template(path: Any, leaf: Any) -> Any:
+        info = _leaf_info(leaf)
+        if info is None:
+            return leaf
+        shape, dtype, _ = info
+        path_s = _path_str(path)
+        entry = by_path.get(path_s)
+        if rule is not None:
+            spec = rule(path_s, shape)
+        elif entry is not None:
+            spec = decode_spec(entry.get("spec"))
+        else:
+            spec = P()
+        spec = validated_spec_strict(spec, shape, mesh, path=path_s)
+        return jax.ShapeDtypeStruct(
+            shape, np.dtype(dtype), sharding=NamedSharding(mesh, spec)
+        )
+
+    return jax.tree_util.tree_map_with_path(leaf_template, like)
+
+
+def topology_changed(
+    manifest: dict[str, Any] | None, mesh: Mesh | None = None
+) -> bool:
+    """Did the world change since this manifest was written? True when
+    the controller process count or the mesh axis sizes differ from the
+    current ones (``mesh`` defaults to the runtime's global mesh); False
+    when they match or the manifest predates topology recording."""
+    if manifest is None:
+        return False
+    if int(manifest.get("process_count", 0)) != jax.process_count():
+        return True
+    saved_mesh = manifest.get("mesh")
+    if saved_mesh is None:
+        return False
+    if mesh is None:
+        try:
+            from ..runtime import global_mesh
+
+            mesh = global_mesh()
+        except Exception:
+            return False
+    return dict(saved_mesh.get("axes") or {}) != mesh_axes(mesh)

@@ -18,6 +18,10 @@ Usage:
   dispatched on ``kind`` — a trace export (``Tracer.export`` /
   ``scripts/merge_traces.py`` output), a flight-recorder dump, or a
   watchdog hang dump.
+- ``*.json`` files carrying ``"schema": "fluxmpi_tpu.manifest/v1"``
+  (the ``<step>.manifest.json`` topology sidecar every checkpoint save
+  writes): validated against the manifest schema — leaf
+  shapes/dtypes/partition specs, mesh axes, loader geometry.
 - other ``*.json`` files: a bench record — either bench.py's raw output
   (``{"metric": ...}``) or a driver BENCH_*.json wrapper whose ``tail``
   holds the JSON line bench.py printed.
@@ -97,6 +101,9 @@ def check_file(path: str, schema) -> list[str]:
         # Trace-plane file (span export / flight recorder / watchdog
         # dump): validate_trace_file dispatches on its 'kind'.
         return [f"{path}: {e}" for e in schema.validate_trace_file(data)]
+    if isinstance(data, dict) and data.get("schema") == schema.MANIFEST_SCHEMA:
+        # Checkpoint topology manifest (the elastic-restore sidecar).
+        return [f"{path}: {e}" for e in schema.validate_manifest(data)]
     rec = _bench_record_from(data) if isinstance(data, dict) else None
     if rec is None:
         # A wrapper with no bench line is a bench that never ran — not a
