@@ -111,24 +111,18 @@ _ANCHORS: dict[tuple[str, str, str], float] = {
     ("cifar_cnn_images_per_sec_per_chip", "cpu", "cpu1"): 319.3,
 }
 
-# Peak bf16 FLOPs/s per chip by device_kind substring (public spec sheets).
-_PEAK_FLOPS = (
-    ("v6", 918e12),  # Trillium
-    ("v5p", 459e12),
-    ("v5e", 197e12),
-    ("v5 lite", 197e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
-)
+# FLOPs/MFU accounting lives in fluxmpi_tpu.utils.flops (promoted out of
+# this file so the live run-health plane computes MFU with the SAME peak
+# table and formula the bench reports). The delegates below import it
+# lazily: the parent driver must stay importable without booting jax —
+# `import fluxmpi_tpu` initializes the backend, which on a wedged tunnel
+# hangs instead of failing fast.
 
 
 def _chip_peak_flops(device_kind: str) -> float | None:
-    kind = device_kind.lower()
-    for sub, peak in _PEAK_FLOPS:
-        if sub in kind:
-            return peak
-    return None
+    from fluxmpi_tpu.utils.flops import chip_peak_flops
+
+    return chip_peak_flops(device_kind)
 
 
 def _device_fingerprint(platform: str, device_kind: str) -> str:
@@ -266,18 +260,28 @@ def _dispatch_probe(mesh) -> dict | None:
 
 
 def _cost_analysis_flops(step, state, data) -> float | None:
-    """FLOPs per compiled step straight from XLA's cost model, if exposed."""
-    try:
-        compiled = step.lower(state, data).compile()
-        analysis = compiled.cost_analysis()
-        if isinstance(analysis, (list, tuple)):
-            analysis = analysis[0] if analysis else None
-        if analysis:
-            flops = float(analysis.get("flops", 0.0))
-            return flops if flops > 0 else None
-    except Exception:
-        pass
-    return None
+    """FLOPs per compiled step straight from XLA's cost model, if exposed
+    (delegates to the shared helper the live goodput plane also uses)."""
+    from fluxmpi_tpu.utils.flops import cost_analysis_flops
+
+    return cost_analysis_flops(step, state, data)
+
+
+def _raw_mfu(
+    flops_per_step: float | None, rate: float, n_dev: int, device_kind: str
+) -> float | None:
+    from fluxmpi_tpu.utils.flops import mfu
+
+    return mfu(flops_per_step, rate, n_dev, device_kind)
+
+
+def _discard_impossible(mfu: float | None) -> tuple[float | None, bool]:
+    """The ONE discard policy for impossible MFU (>1.0: a broken clock
+    or FLOPs estimate, never real): ``(value_or_None, discarded)``."""
+    if mfu is not None and mfu > 1.0:
+        print(f"bench: discarding impossible MFU {mfu:.2f}", file=sys.stderr)
+        return None, True
+    return mfu, False
 
 
 def _mfu(
@@ -285,17 +289,13 @@ def _mfu(
 ) -> float | None:
     """Model FLOPs utilization per chip: FLOPs/step × steps/sec ÷
     (chips × peak). Returns None when peak is unknown or the number is
-    impossible (>1: a broken clock or FLOPs estimate, never real)."""
-    if not flops_per_step:
-        return None
-    peak = _chip_peak_flops(device_kind)
-    if peak is None:
-        return None
-    mfu = flops_per_step * rate / (n_dev * peak)
-    if mfu > 1.0:
-        print(f"bench: discarding impossible MFU {mfu:.2f}", file=sys.stderr)
-        return None
-    return round(mfu, 4)
+    impossible — callers wanting the discard *recorded* take the flag
+    from ``_discard_impossible`` and bank ``mfu_discarded`` (see
+    ``_bench_workload``)."""
+    value, _ = _discard_impossible(
+        _raw_mfu(flops_per_step, rate, n_dev, device_kind)
+    )
+    return value
 
 
 def _visible_devices():
@@ -413,7 +413,11 @@ def _bench_workload(
     rate, state = _steps_per_sec(
         timed_step, state, timed_data, warmup=3, steps=steps
     )
-    mfu = _mfu(flops_per_step, rate, n_dev, device_kind)
+    # The discard itself is a signal (stderr alone was invisible to
+    # trajectory tooling), so it rides the record as mfu_discarded.
+    mfu, mfu_discarded = _discard_impossible(
+        _raw_mfu(flops_per_step, rate, n_dev, device_kind)
+    )
 
     value = round(batch * scan * rate * value_scale / n_dev, ndigits)
     anchor = _anchor_for(metric_name)
@@ -428,6 +432,8 @@ def _bench_workload(
     }
     if mfu is not None:
         result["mfu"] = mfu
+    if mfu_discarded:
+        result["mfu_discarded"] = True
     if xla_flops and analytic_flops is None:
         result["flops_source"] = "xla_cost_analysis"
     if scan > 1:

@@ -30,7 +30,21 @@ and tf.data's pipelined input processing (Murray et al., VLDB 2021).
 - **flush-boundary instrumentation** — telemetry and watchdog hooks run
   every ``flush_every`` updates (and at the end), not per step: the
   steady state pays zero per-step host blocking for metrics, and the
-  recorded numbers are interval aggregates over honestly-drained work.
+  recorded numbers are interval aggregates over honestly-drained work;
+- **run-health plane** — when the goodput tracker is enabled
+  (``init(goodput=True)`` / ``FLUXMPI_TPU_GOODPUT=1``) the loop
+  attributes its wall clock into the
+  :mod:`~fluxmpi_tpu.telemetry.goodput` buckets (productive step,
+  first-dispatch compile, data stall, checkpoint save/restore, resume,
+  preemption drain) and records live MFU from the same FLOPs helpers
+  ``bench.py`` uses; when an
+  :class:`~fluxmpi_tpu.telemetry.AnomalyDetector` is installed
+  (``init(anomaly=True)`` / ``FLUXMPI_TPU_ANOMALY=1``) each flush's
+  loss/grad-norm/step-time is checked and a ``halt``-policy trigger
+  drains and exits cleanly with ``summary["anomaly"]`` set. Both planes
+  sit behind the PR 4 zero-cost-when-off contract: fully disabled, the
+  hot loop performs no extra perf_counter reads and no registry
+  lookups.
 
 After warmup the per-update host cost is one dict-free dispatch (1/K of
 one, under ``scan_steps=K``) — the steady-state hot-path contract (see
@@ -39,6 +53,7 @@ docs/performance.md, "The steady-state loop").
 
 from __future__ import annotations
 
+import contextlib
 import time
 from collections import deque
 from typing import Any, Iterable
@@ -77,6 +92,21 @@ def _epoch_len(batches: Any, scan_steps: int) -> int | None:
     if scan_steps > 1 and isinstance(batches, DistributedDataLoader):
         return n // scan_steps
     return n
+
+
+def _stall_timed(it: Any, gp: Any) -> Iterable[Any]:
+    """Wrap an epoch iterator so the host wait for each batch lands in
+    the goodput ``data_stall`` bucket (enabled-tracker path only — the
+    off path iterates the source directly, paying nothing)."""
+    clock = gp._clock
+    while True:
+        t0 = clock()
+        try:
+            batch = next(it)
+        except StopIteration:
+            return
+        gp.add("data_stall", clock() - t0)
+        yield batch
 
 
 def _batch_examples(batch: Any, scan_steps: int) -> int:
@@ -199,11 +229,27 @@ def train_loop(
     cleanly with ``summary["preempted"] = True`` — a
     ``train.preemption`` instant lands on the trace timeline.
 
+    Run health: with the goodput tracker enabled (``init(goodput=True)``
+    / ``FLUXMPI_TPU_GOODPUT=1``) the loop attributes wall time into the
+    :mod:`~fluxmpi_tpu.telemetry.goodput` buckets and records live
+    ``goodput.*`` metrics (MFU included) at every flush; with an
+    anomaly detector installed (``init(anomaly=True)`` /
+    ``FLUXMPI_TPU_ANOMALY=1``) each flush's loss / grad-norm /
+    step-time feeds its rules — a ``halt``-policy trigger (NaN loss by
+    default) drains the window, skips further checkpoint saves (the
+    last periodic save holds the last known-good state), and returns
+    cleanly with ``summary["anomaly"]`` naming the rule, a diagnostics
+    bundle on disk. Fully disabled (the default), neither plane adds
+    perf_counter reads or registry lookups to the hot loop.
+
     Returns:
       ``(final_state, summary)`` — summary has ``updates``, ``epochs``,
       ``examples``, ``seconds``, ``updates_per_sec``,
-      ``examples_per_sec``, final ``loss``, ``preempted``, and
-      ``resumed_from`` (the checkpoint step resumed from, else None).
+      ``examples_per_sec``, final ``loss``, ``preempted``,
+      ``resumed_from`` (the checkpoint step resumed from, else None),
+      ``anomaly`` (the halting rule, else None), and — goodput enabled
+      only — ``goodput`` (the tracker's
+      :meth:`~fluxmpi_tpu.telemetry.GoodputTracker.report`).
     """
     from ..data import DistributedDataLoader
     from ..telemetry.watchdog import notify_progress
@@ -245,7 +291,29 @@ def train_loop(
         reg, monitor, hook = _resolve_metrics(metrics)
     from .. import comm as _comm
     from ..telemetry import get_registry
+    from ..telemetry import anomaly as _anomaly
+    from ..telemetry import goodput as _goodput
     from .train import _DEFAULT_REGISTRY
+
+    # Run-health plane, resolved ONCE per run (the zero-cost-when-off
+    # contract: with both disabled the hot loop below branches on two
+    # local bools — no perf_counter reads, no registry lookups, no
+    # context managers). Enablement is env/init-driven, hence
+    # SPMD-consistent; halt decisions are made at flush boundaries every
+    # process reaches at the same updates count, from SPMD-consistent
+    # signals (see telemetry/anomaly.py on policies).
+    gp = _goodput.get_goodput_tracker()
+    gp_on = gp.enabled
+    detector = _anomaly.get_anomaly_detector()
+    det_on = detector is not None and detector.enabled
+    halt_rule: str | None = None
+    if gp_on:
+        # One tracker window per train_loop run: without the reset, a
+        # second loop in the same process would inherit the first run's
+        # buckets, book the gap between runs as host_idle, and compute
+        # MFU from the FIRST step function's FLOPs.
+        gp.reset_run()
+        gp.start_run()  # anchor the wall clock before resume bring-up
 
     # Multi-process preemption coordination polls only when it could
     # matter (signal handlers installed, or a checkpoint to bank into) —
@@ -347,6 +415,11 @@ def train_loop(
     resumed_from = None
     resume_offset = 0  # dispatches already done in a resumed partial epoch
     if resume:
+      # Resume bring-up is restart badput (elastic resizes included):
+      # the whole block — manifest read, restore, cursor remap — lands
+      # in the goodput "resume" bucket; the nested checkpoint_restore
+      # segment inside checkpoint.restore counts once (outermost wins).
+      with gp.segment("resume") if gp_on else contextlib.nullcontext():
         # The manifest (the topology sidecar every PR 6 save writes)
         # tells us, BEFORE any bytes move, whether the checkpoint comes
         # from a different world — and whether it predates manifests, in
@@ -439,22 +512,39 @@ def train_loop(
     t_start = time.perf_counter()
     t_flush = t_start
 
+    # Per-interval delta base for the goodput data_stall bucket — what
+    # the anomaly data-stall rule compares against the interval's step
+    # time (per-update loader wait needs goodput enabled to exist).
+    stall_base = gp.bucket_seconds("data_stall") if gp_on else 0.0
+
     def flush() -> None:
         nonlocal interval_updates, interval_examples, t_flush
+        nonlocal halt_rule, stall_base
         if interval_updates == 0:
             return
         if last_out is not None:
             # Drain to the newest dispatched result so the interval's wall
             # time covers completed work, not enqueued promises — the
-            # step_timer discipline at flush granularity.
-            jax.block_until_ready(last_out)
+            # step_timer discipline at flush granularity. The drain is
+            # honest device compute: productive goodput.
+            if gp_on:
+                with gp.segment("step"):
+                    jax.block_until_ready(last_out)
+            else:
+                jax.block_until_ready(last_out)
         now = time.perf_counter()
         elapsed = now - t_flush
         per_update = elapsed / interval_updates
         notify_progress(interval_updates)
-        if record_metrics:
+        loss_v: float | None = None
+        grad_v: float | None = None
+        if record_metrics or det_on:
             leaves = jax.tree_util.tree_leaves(last_out)
             loss_h = np.asarray(jax.device_get(leaves[0])) if leaves else None
+            loss_v = float(loss_h.mean()) if loss_h is not None else None
+            if len(leaves) > 1:
+                grad_v = float(np.asarray(jax.device_get(leaves[1])).mean())
+        if record_metrics:
             record: dict[str, Any] = {
                 "step_seconds": per_update,
                 "steps": interval_updates,
@@ -462,12 +552,10 @@ def train_loop(
                 "examples_per_sec": (
                     interval_examples / elapsed if elapsed > 0 else 0.0
                 ),
-                "loss": float(loss_h.mean()) if loss_h is not None else None,
+                "loss": loss_v,
             }
-            if len(leaves) > 1:
-                record["grad_norm"] = float(
-                    np.asarray(jax.device_get(leaves[1])).mean()
-                )
+            if grad_v is not None:
+                record["grad_norm"] = grad_v
             registry = _live_registry()
             if registry is not None:
                 registry.histogram("train.step_seconds").observe(per_update)
@@ -484,11 +572,30 @@ def train_loop(
                 monitor.observe_step(per_update)
             if hook is not None:
                 hook(record)
+        fetch_per_update: float | None = None
+        if gp_on:
+            stall = gp.bucket_seconds("data_stall")
+            fetch_per_update = (stall - stall_base) / interval_updates
+            stall_base = stall
+            # goodput.* gauges ride the same flush line as train.*.
+            gp.record(_live_registry() if record_metrics else None)
+        if det_on:
+            events = detector.observe(
+                loss=loss_v,
+                grad_norm=grad_v,
+                step_seconds=per_update,
+                fetch_seconds=fetch_per_update,
+                step=updates,
+            )
+            for ev in events:
+                if ev["action"] == "halt" and halt_rule is None:
+                    halt_rule = ev["rule"]
         interval_updates = 0
         interval_examples = 0
         t_flush = time.perf_counter()
 
     done = False
+    first_dispatch = True
     while not done:
         if epochs is not None and epochs_done >= epochs:
             break
@@ -501,12 +608,42 @@ def train_loop(
         dispatched_this_epoch = offset
         yielded_this_pass = 0
         exhausted = False
-        for batch in _epoch_iter(batches, k):
-            state, out = hot(state, batch)
+        source = _epoch_iter(batches, k)
+        if gp_on:
+            # Loader waits land in the data_stall bucket; the off path
+            # iterates the source directly (no wrapper, no clock reads).
+            source = _stall_timed(iter(source), gp)
+        for batch in source:
+            if gp_on:
+                if first_dispatch and gp._flops_per_update is None:
+                    # FLOPs per update from XLA's cost model, BEFORE the
+                    # donating dispatch consumes the state buffers — the
+                    # same accounting bench.py reports, so live MFU and
+                    # bench MFU share one implementation. The lowering
+                    # this pays is compile work: attributed as such.
+                    from ..utils.flops import cost_analysis_flops
+
+                    with gp.segment("compile"):
+                        flops = cost_analysis_flops(hot, state, batch)
+                    if flops:
+                        gp.set_flops_per_update(flops / k)
+                # The first dispatch traces + compiles synchronously —
+                # the compile bucket; steady-state dispatches (and the
+                # window-full block on the oldest result) are the
+                # productive step bucket.
+                with gp.segment("compile" if first_dispatch else "step"):
+                    state, out = hot(state, batch)
+                    window.append(out)
+                    if len(window) > in_flight:
+                        jax.block_until_ready(window.popleft())
+                gp.note_updates(k)
+            else:
+                state, out = hot(state, batch)
+                window.append(out)
+                if len(window) > in_flight:
+                    jax.block_until_ready(window.popleft())
+            first_dispatch = False
             last_out = out
-            window.append(out)
-            if len(window) > in_flight:
-                jax.block_until_ready(window.popleft())
             n = _batch_examples(batch, k)
             updates += k
             examples += n
@@ -517,6 +654,14 @@ def train_loop(
             at_flush = interval_updates >= flush_every
             if at_flush:
                 flush()
+                if halt_rule is not None:
+                    # An anomaly with a halt policy: stop at this flush
+                    # boundary (SPMD-consistent — every process reached
+                    # it at the same updates count and judged the same
+                    # global scalars) WITHOUT banking a checkpoint of
+                    # the now-suspect state; the last periodic save
+                    # holds the last known-good boundary.
+                    done = True
             if steps is not None and updates >= steps:
                 done = True
             # Dispatch-boundary fault-tolerance hooks, in commit order:
@@ -525,6 +670,7 @@ def train_loop(
             if (
                 checkpoint is not None
                 and save_every is not None
+                and halt_rule is None
                 and updates - last_saved >= save_every
             ):
                 _save_ckpt()
@@ -567,16 +713,31 @@ def train_loop(
                 "pass a re-iterable loader for multi-epoch runs"
             )
 
-    while window:
-        jax.block_until_ready(window.popleft())
+    if gp_on and window:
+        # Draining after a preemption is badput the preemption caused;
+        # a normal end-of-run drain is the tail of productive compute.
+        with gp.segment("preemption_drain" if preempted else "step"):
+            while window:
+                jax.block_until_ready(window.popleft())
+    else:
+        while window:
+            jax.block_until_ready(window.popleft())
     flush()
     if preempted:
         # Drained and flushed: bank the final boundary and exit cleanly.
         # The trace instant is the preemption event the schema validates.
         _tracing.instant("train.preemption", step=int(updates))
-        if checkpoint is not None and updates > last_saved:
+        if (
+            checkpoint is not None
+            and updates > last_saved
+            and halt_rule is None
+        ):
             # Past the epoch-accounting block: a completed pass is
-            # already in epochs_done.
+            # already in epochs_done. A halt-policy anomaly (set at the
+            # stopping flush, or by the final post-drain flush above)
+            # gates the emergency save like the periodic ones — a
+            # preemption coinciding with a NaN must not make the
+            # diverged state the newest restorable checkpoint.
             _save_ckpt(pass_counted=True)
     if checkpoint is not None:
         checkpoint.wait_until_finished()
@@ -596,5 +757,12 @@ def train_loop(
         "loss": loss,
         "preempted": preempted,
         "resumed_from": resumed_from,
+        "anomaly": halt_rule,
     }
+    if gp_on:
+        # Final record covers the drain/emergency-save tail the last
+        # in-loop flush could not see; the report rides the summary so
+        # callers get the breakdown without touching the registry.
+        gp.record(_live_registry() if record_metrics else None)
+        summary["goodput"] = gp.report()
     return state, summary

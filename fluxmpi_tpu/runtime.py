@@ -225,6 +225,8 @@ def init(
     watchdog: Any = None,
     preemption: Any = None,
     faults: Any = None,
+    goodput: Any = None,
+    anomaly: Any = None,
 ) -> Mesh:
     """Bring up the fluxmpi_tpu runtime. Idempotent.
 
@@ -278,14 +280,28 @@ def init(
       faults: arm a fault-injection schedule (grammar in
         :mod:`fluxmpi_tpu.faults`, e.g. ``"comm.allreduce@step=7"``).
         ``None`` defers to ``FLUXMPI_TPU_FAULTS``; ``False`` disarms.
-        All four observability/robustness specs are applied on
-        idempotent replays too.
+      goodput: enable the run-health goodput plane — ``True`` turns on
+        wall-clock badput attribution + live MFU in
+        :func:`~fluxmpi_tpu.parallel.train_loop` (see
+        :mod:`fluxmpi_tpu.telemetry.goodput`), or pass a
+        :class:`~fluxmpi_tpu.telemetry.GoodputTracker` to install
+        custom wiring. ``None`` defers to ``FLUXMPI_TPU_GOODPUT``.
+      anomaly: install the anomaly detector — ``True`` = defaults (NaN
+        loss/grad halt the loop cleanly, statistical rules warn),
+        ``"warn"`` = observe-only, or an
+        :class:`~fluxmpi_tpu.telemetry.AnomalyDetector`; on trigger an
+        ``anomaly.*`` instant + a diagnostics bundle are emitted (see
+        :mod:`fluxmpi_tpu.telemetry.anomaly`). ``None`` defers to
+        ``FLUXMPI_TPU_ANOMALY``. All the observability/robustness specs
+        are applied on idempotent replays too.
 
     Returns:
       The global :class:`jax.sharding.Mesh`.
     """
     from .logging import fluxmpi_println  # local import: avoid cycle
+    from .telemetry import anomaly as _anomaly
     from .telemetry import configure as _configure_telemetry
+    from .telemetry import goodput as _goodput
     from .telemetry import tracing as _tracing
     from .telemetry import watchdog as _watchdog
     from . import faults as _faults_mod
@@ -296,6 +312,8 @@ def init(
         _watchdog.configure(watchdog)
         _configure_preemption(preemption)
         _faults_mod.configure(faults)
+        _goodput.configure(goodput)
+        _anomaly.configure(anomaly)
         if verbose:
             fluxmpi_println("fluxmpi_tpu already initialized; skipping...")
         assert _state.mesh is not None
@@ -350,6 +368,8 @@ def init(
     _watchdog.configure(watchdog)
     _configure_preemption(preemption)
     _faults_mod.configure(faults)
+    _goodput.configure(goodput)
+    _anomaly.configure(anomaly)
 
     if verbose:
         if total_workers() == 1:

@@ -1,7 +1,7 @@
-"""Telemetry: metrics, tracing, flight recorder, watchdog, monitor.
+"""Telemetry: metrics, tracing, flight recorder, watchdog, run health.
 
 The observability layer the reference never had (SURVEY.md §5: its only
-timing is ad-hoc wall-clock deltas in example scripts). Two planes:
+timing is ad-hoc wall-clock deltas in example scripts). Three planes:
 
 **Metrics plane** (PR 1) — aggregates over time:
 
@@ -30,6 +30,21 @@ desynchronize?"):
 - :mod:`~fluxmpi_tpu.telemetry.watchdog` — opt-in stall detector that
   dumps all-thread stacks, the flight-recorder tail, open spans, and a
   final registry flush to one artifact per host (also on ``SIGUSR1``).
+
+**Run-health plane** (PR 7) — is the wall-clock buying training
+progress, and is the run still sane:
+
+- :mod:`~fluxmpi_tpu.telemetry.goodput` — :class:`GoodputTracker`
+  attributes wall time into goodput/badput buckets (productive step,
+  compile, data stall, checkpoint I/O, resume, preemption drain) and
+  computes **live MFU** from the same FLOPs helpers ``bench.py`` uses
+  (:mod:`fluxmpi_tpu.utils.flops`); per-run breakdowns via
+  ``scripts/goodput_report.py``;
+- :mod:`~fluxmpi_tpu.telemetry.anomaly` — :class:`AnomalyDetector`
+  with NaN/Inf, loss-spike (EWMA z-score), step-time-regression, and
+  data-stall rules; warn/halt policies; triggers emit an ``anomaly.*``
+  trace instant and a diagnostics bundle built from the watchdog's
+  dump machinery.
 
 Recording is always on for metrics and the flight recorder (updates are
 a few dict/deque ops); span recording and the watchdog are opt-in
@@ -92,6 +107,18 @@ from .watchdog import (  # noqa: F401
     get_watchdog,
     notify_progress,
 )
+from . import goodput  # noqa: F401
+from .goodput import (  # noqa: F401
+    GoodputTracker,
+    get_goodput_tracker,
+    set_goodput_tracker,
+)
+from . import anomaly  # noqa: F401
+from .anomaly import (  # noqa: F401
+    AnomalyDetector,
+    get_anomaly_detector,
+    set_anomaly_detector,
+)
 
 __all__ = [
     "Counter",
@@ -129,6 +156,12 @@ __all__ = [
     "disarm_watchdog",
     "get_watchdog",
     "notify_progress",
+    "GoodputTracker",
+    "get_goodput_tracker",
+    "set_goodput_tracker",
+    "AnomalyDetector",
+    "get_anomaly_detector",
+    "set_anomaly_detector",
     "configure",
     "shutdown",
 ]
@@ -194,15 +227,24 @@ def configure(spec: Any = None) -> MetricsRegistry:
 def shutdown() -> None:
     """Tear down the observability planes in failure-safe order: disarm
     the watchdog, export the trace ring (when a path was configured),
-    then flush and detach every sink on the default registry
-    (instruments survive — a re-configured registry keeps its cumulative
-    counters)."""
+    reset the run-health plane (goodput window + anomaly detector —
+    state left armed would leak into the next init cycle), then flush
+    and detach every sink on the default registry (instruments survive —
+    a re-configured registry keeps its cumulative counters)."""
     try:
         disarm_watchdog()
     except Exception:
         pass
     try:
         tracing.shutdown()
+    except Exception:
+        pass
+    try:
+        goodput.shutdown()
+    except Exception:
+        pass
+    try:
+        anomaly.shutdown()
     except Exception:
         pass
     get_registry().close()

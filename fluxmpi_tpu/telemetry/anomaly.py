@@ -1,0 +1,450 @@
+"""Run-health anomaly detection: catch a diverged run before a human does.
+
+A NaN loss on a pod burns every chip until somebody looks at a
+dashboard; before this plane the live loop had zero NaN / loss-spike /
+grad-explosion detection. :class:`AnomalyDetector` evaluates a small
+rule set against the numbers ``train_loop`` already computes at flush
+boundaries (no extra device syncs):
+
+==========================  ================================================
+rule                        trigger
+==========================  ================================================
+``nan_loss``                loss is NaN/Inf
+``nan_grad``                global grad norm is NaN/Inf
+``loss_spike``              loss z-score vs a rolling EWMA mean/variance
+                            exceeds ``spike_zscore`` (after ``warmup``
+                            observations)
+``step_time_regression``    interval step time exceeds ``step_time_factor``
+                            × its EWMA (after ``warmup``)
+``data_stall``              per-update loader wait exceeds
+                            ``data_stall_factor`` × the interval's
+                            *compute* remainder (step time − wait) — the
+                            device is input-bound
+==========================  ================================================
+
+Each rule carries a **policy**: ``"warn"`` (record and continue),
+``"halt"`` (``train_loop`` drains the in-flight window, flushes, and
+returns cleanly with ``summary["anomaly"]`` set — the preemption exit
+discipline, no mid-collective abort), or ``"off"``. Defaults: NaN rules
+halt, the statistical rules warn — in a multi-process world only
+SPMD-consistent signals (the loss and grad norm are global scalars,
+identical on every process) are safe to halt on; a per-host signal like
+step time would desync the collectives, so leave those on ``"warn"``.
+
+On trigger the detector emits the full diagnostic surface:
+
+- an ``anomaly.<rule>`` trace **instant** (schema-validated: instants
+  must carry ``args.step`` and ``args.rule``) on the span timeline;
+- the ``anomaly.triggered{rule=...}`` counter in the metrics plane;
+- a **diagnostics bundle** — ``fluxmpi_anomaly.<process>.json``, built
+  by the watchdog's dump machinery (all-thread stacks, the collective
+  flight-recorder tail, open spans, a final registry flush) plus an
+  ``anomaly`` section naming the rule/value/step — so the artifact a
+  responder needs exists the moment the run went wrong, not after an
+  interactive session reproduces it.
+
+Zero-cost-when-off: no detector installed (the default) means
+``train_loop`` reads one module attribute per run and never calls
+:meth:`observe`.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import warnings
+from typing import Any
+
+from .registry import MetricsRegistry, get_registry
+from .registry import process_index_or_zero as _process_index
+
+__all__ = [
+    "AnomalyDetector",
+    "get_anomaly_detector",
+    "set_anomaly_detector",
+    "configure",
+    "shutdown",
+    "RULES",
+    "POLICIES",
+]
+
+_ENV_VAR = "FLUXMPI_TPU_ANOMALY"
+_ENV_DIR = "FLUXMPI_TPU_ANOMALY_DIR"
+
+RULES = (
+    "nan_loss",
+    "nan_grad",
+    "loss_spike",
+    "step_time_regression",
+    "data_stall",
+)
+
+POLICIES = ("warn", "halt", "off")
+
+_DEFAULT_POLICIES = {
+    "nan_loss": "halt",
+    "nan_grad": "halt",
+    "loss_spike": "warn",
+    "step_time_regression": "warn",
+    "data_stall": "warn",
+}
+
+
+def _finite(x: float) -> bool:
+    return math.isfinite(x)
+
+
+class AnomalyDetector:
+    """Flush-boundary anomaly rules with warn/halt policies.
+
+    Args:
+      registry: registry the ``anomaly.triggered`` counter records into
+        (default: the process-global one).
+      policies: per-rule overrides of the defaults (NaN rules ``halt``,
+        statistical rules ``warn``), e.g. ``{"loss_spike": "halt",
+        "data_stall": "off"}``. Unknown rules / policies raise.
+      spike_zscore: loss z-score (vs the rolling EWMA mean and variance)
+        that counts as a spike.
+      ewma_alpha: EWMA smoothing factor for the loss and step-time
+        baselines (weight of the newest observation).
+      warmup: observations a statistical baseline needs before its rule
+        arms — the first steps of a run are legitimately wild.
+      step_time_factor: interval step time > factor × EWMA = regression.
+      data_stall_factor: per-update loader wait > factor × the interval's
+        compute remainder (step time − wait) = input-bound (the wait is
+        part of the step time, so it is judged against what is left).
+      dump_dir: where the diagnostics bundle lands (default
+        ``FLUXMPI_TPU_ANOMALY_DIR`` or ``.``); stable per-process
+        filename, latest trigger wins (the watchdog convention).
+      dump: write bundles at all (tests that only want the rule engine
+        turn it off).
+    """
+
+    def __init__(
+        self,
+        *,
+        registry: MetricsRegistry | None = None,
+        policies: dict[str, str] | None = None,
+        spike_zscore: float = 6.0,
+        ewma_alpha: float = 0.1,
+        warmup: int = 5,
+        step_time_factor: float = 3.0,
+        data_stall_factor: float = 1.0,
+        dump_dir: str | None = None,
+        dump: bool = True,
+    ):
+        self.enabled = True
+        self._registry = registry
+        self.policies = dict(_DEFAULT_POLICIES)
+        for rule, policy in (policies or {}).items():
+            if rule not in RULES:
+                raise ValueError(
+                    f"unknown anomaly rule {rule!r}; known: {RULES}"
+                )
+            if policy not in POLICIES:
+                raise ValueError(
+                    f"anomaly policy must be one of {POLICIES}, "
+                    f"got {policy!r} for rule {rule!r}"
+                )
+            self.policies[rule] = policy
+        if not 0.0 < ewma_alpha <= 1.0:
+            raise ValueError(f"ewma_alpha must be in (0, 1], got {ewma_alpha}")
+        if warmup < 1:
+            raise ValueError(f"warmup must be >= 1, got {warmup}")
+        self.spike_zscore = float(spike_zscore)
+        self.ewma_alpha = float(ewma_alpha)
+        self.warmup = int(warmup)
+        self.step_time_factor = float(step_time_factor)
+        self.data_stall_factor = float(data_stall_factor)
+        self.dump_dir = (
+            dump_dir
+            if dump_dir is not None
+            else os.environ.get(_ENV_DIR, ".")
+        )
+        self.dump = dump
+        self.last_dump_path: str | None = None
+        self.triggered: list[dict[str, Any]] = []
+        # Rolling baselines (EWMA mean + variance for loss; EWMA mean
+        # for step time) and their observation counts.
+        self._loss_mean = 0.0
+        self._loss_var = 0.0
+        self._loss_n = 0
+        self._step_mean = 0.0
+        self._step_n = 0
+
+    # -- rule engine ---------------------------------------------------
+
+    def _event(
+        self, rule: str, value: float, step: int | None
+    ) -> dict[str, Any] | None:
+        action = self.policies[rule]
+        if action == "off":
+            return None
+        value = float(value)
+        return {
+            "rule": rule,
+            "action": action,
+            # The flagship NaN rules carry a non-finite trigger value;
+            # json.dump would write the literal `NaN` — invalid strict
+            # JSON that makes Perfetto reject the whole trace export
+            # and jq choke on the bundle. Numeric slot goes null, the
+            # repr keeps the actual trigger readable.
+            "value": value if math.isfinite(value) else None,
+            "value_repr": f"{value:.6g}",
+            "step": int(step) if step is not None else None,
+        }
+
+    def observe(
+        self,
+        *,
+        loss: float | None = None,
+        grad_norm: float | None = None,
+        step_seconds: float | None = None,
+        fetch_seconds: float | None = None,
+        step: int | None = None,
+    ) -> list[dict[str, Any]]:
+        """Evaluate every armed rule against one flush interval's
+        numbers; returns the triggered events (each ``{"rule", "action",
+        "value", "value_repr", "step"}`` — ``value`` is null for
+        non-finite triggers, ``value_repr`` always carries the number),
+        already emitted (instant + counter + bundle). ``train_loop`` halts when any event's action is
+        ``"halt"``. All inputs optional — a rule whose input is absent
+        stays quiet (``fetch_seconds`` is the per-update loader wait,
+        which the loop derives from the goodput plane's ``data_stall``
+        bucket, so the data-stall rule needs goodput enabled there)."""
+        if not self.enabled:
+            return []
+        events: list[dict[str, Any]] = []
+
+        if loss is not None:
+            loss = float(loss)
+            if not _finite(loss):
+                ev = self._event("nan_loss", loss, step)
+                if ev:
+                    events.append(ev)
+            else:
+                if self._loss_n >= self.warmup:
+                    std = math.sqrt(max(self._loss_var, 0.0))
+                    if std > 0.0:
+                        z = (loss - self._loss_mean) / std
+                        if z > self.spike_zscore:
+                            ev = self._event("loss_spike", z, step)
+                            if ev:
+                                events.append(ev)
+                # Update the baseline AFTER the check (a spike must not
+                # vaccinate the mean it is judged against); West's EWMA
+                # variance update.
+                a = self.ewma_alpha
+                if self._loss_n == 0:
+                    self._loss_mean = loss
+                    self._loss_var = 0.0
+                else:
+                    delta = loss - self._loss_mean
+                    self._loss_mean += a * delta
+                    self._loss_var = (1 - a) * (self._loss_var + a * delta**2)
+                self._loss_n += 1
+
+        if grad_norm is not None:
+            grad_norm = float(grad_norm)
+            if not _finite(grad_norm):
+                ev = self._event("nan_grad", grad_norm, step)
+                if ev:
+                    events.append(ev)
+
+        if step_seconds is not None and step_seconds > 0:
+            step_seconds = float(step_seconds)
+            if (
+                self._step_n >= self.warmup
+                and self._step_mean > 0
+                and step_seconds > self.step_time_factor * self._step_mean
+            ):
+                ev = self._event(
+                    "step_time_regression",
+                    step_seconds / self._step_mean,
+                    step,
+                )
+                if ev:
+                    events.append(ev)
+            a = self.ewma_alpha
+            if self._step_n == 0:
+                self._step_mean = step_seconds
+            else:
+                self._step_mean += a * (step_seconds - self._step_mean)
+            self._step_n += 1
+
+        if (
+            fetch_seconds is not None
+            and step_seconds is not None
+            and step_seconds > 0
+        ):
+            # Input-bound test: the loader wait is PART of the wall
+            # step time, so it is compared against the remainder (the
+            # compute the device actually got) — fetch vs the whole
+            # interval could never exceed 1x and the rule would be
+            # dead by construction.
+            compute = max(float(step_seconds) - float(fetch_seconds), 0.0)
+            if (
+                compute <= 0.0
+                or fetch_seconds > self.data_stall_factor * compute
+            ):
+                # Finite ratio even at compute==0 (all-wait interval):
+                # the event value must stay strict-JSON-serializable.
+                ratio = float(fetch_seconds) / max(compute, 1e-9)
+                ev = self._event("data_stall", ratio, step)
+                if ev:
+                    events.append(ev)
+
+        for ev in events:
+            self._emit(ev)
+        return events
+
+    # -- emission ------------------------------------------------------
+
+    def _emit(self, ev: dict[str, Any]) -> None:
+        self.triggered.append(ev)
+        reg = self._registry if self._registry is not None else get_registry()
+        if getattr(reg, "enabled", True):
+            reg.counter("anomaly.triggered", rule=ev["rule"]).inc()
+        from . import tracing as _tracing
+
+        _tracing.instant(
+            "anomaly." + ev["rule"],
+            rule=ev["rule"],
+            step=int(ev["step"] or 0),
+            value=ev["value"],
+            value_repr=ev["value_repr"],
+            action=ev["action"],
+        )
+        warnings.warn(
+            f"anomaly detected: {ev['rule']} (value {ev['value_repr']} at "
+            f"step {ev['step']}) — policy {ev['action']!r}"
+            + (
+                f"; diagnostics bundle at {self.dump_path()}"
+                if self.dump
+                else ""
+            ),
+            stacklevel=4,
+        )
+        if self.dump:
+            try:
+                self.write_bundle(ev)
+            except Exception as exc:  # diagnostics must never kill the run
+                warnings.warn(
+                    f"anomaly diagnostics bundle write failed: {exc!r}",
+                    stacklevel=4,
+                )
+
+    def dump_path(self) -> str:
+        return os.path.join(
+            self.dump_dir, f"fluxmpi_anomaly.{_process_index()}.json"
+        )
+
+    def write_bundle(self, ev: dict[str, Any]) -> str:
+        """Write the diagnostics bundle for one event and return its
+        path. Reuses the watchdog's dump machinery — the bundle IS a
+        ``watchdog_dump``-kind record (thread stacks, flight-recorder
+        tail, open spans, final registry flush) with an extra
+        ``anomaly`` section, so the existing schema validator and triage
+        tooling (``diff_flight_dumps``) apply unchanged."""
+        from .watchdog import Watchdog, get_watchdog
+
+        wd = get_watchdog()
+        if wd is None:
+            # An unarmed builder: build_dump never starts threads or
+            # installs signals — it only assembles the record.
+            wd = Watchdog(deadline=1.0, registry=self._registry)
+        record = wd.build_dump(f"anomaly:{ev['rule']}")
+        record["anomaly"] = dict(ev)
+        path = self.dump_path()
+        os.makedirs(self.dump_dir or ".", exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(record, f, indent=1)
+        self.last_dump_path = path
+        return path
+
+
+# ---------------------------------------------------------------------------
+# Default detector wiring (init kwarg / env var)
+# ---------------------------------------------------------------------------
+
+_active: AnomalyDetector | None = None
+_active_lock = threading.Lock()
+
+
+def get_anomaly_detector() -> AnomalyDetector | None:
+    """The installed detector, if any (None = plane off)."""
+    return _active
+
+
+def set_anomaly_detector(
+    detector: AnomalyDetector | None,
+) -> AnomalyDetector | None:
+    """Install (or, with None, remove) the process anomaly detector;
+    returns the previous one."""
+    global _active
+    with _active_lock:
+        prev, _active = _active, detector
+    return prev
+
+
+def configure(spec: Any = None) -> AnomalyDetector | None:
+    """Wire anomaly detection from a one-value spec (mirror of
+    :func:`fluxmpi_tpu.telemetry.configure`):
+
+    - ``None`` — read ``FLUXMPI_TPU_ANOMALY`` (same forms; no-op when
+      unset/empty);
+    - ``False`` / ``"0"`` — uninstall;
+    - ``True`` / ``"1"`` — install a default detector (NaN rules halt,
+      statistical rules warn);
+    - ``"warn"`` — install with EVERY rule on ``"warn"`` (observe-only);
+    - an :class:`AnomalyDetector` — install it.
+
+    Called by ``fluxmpi_tpu.init(anomaly=...)``; idempotent — an
+    installed detector is kept (with its rolling baselines) on a replay
+    with an equivalent spec.
+    """
+    if spec is None:
+        spec = os.environ.get(_ENV_VAR)
+        if spec is None or spec == "":
+            return _active
+    if isinstance(spec, AnomalyDetector):
+        spec.enabled = True
+        set_anomaly_detector(spec)
+        return spec
+    if spec is False or spec == "0":
+        set_anomaly_detector(None)
+        return None
+    if spec is True or spec == "1":
+        # Reuse only a detector that actually carries the default
+        # policies: after configure("warn"), a later configure(True)
+        # must deliver what True documents (NaN rules HALT) — silently
+        # keeping the observe-only detector would let a NaN run burn.
+        if _active is not None and _active.policies == _DEFAULT_POLICIES:
+            _active.enabled = True
+            return _active
+        det = AnomalyDetector()
+        set_anomaly_detector(det)
+        return det
+    if spec == "warn":
+        if _active is not None and all(
+            p in ("warn", "off") for p in _active.policies.values()
+        ):
+            _active.enabled = True
+            return _active
+        det = AnomalyDetector(
+            policies={rule: "warn" for rule in RULES}
+        )
+        set_anomaly_detector(det)
+        return det
+    raise ValueError(
+        f"anomaly spec must be a bool, '0'/'1', 'warn', or an "
+        f"AnomalyDetector; got {spec!r}"
+    )
+
+
+def shutdown() -> None:
+    """Uninstall the detector — baselines and policies must never leak
+    into the next init cycle (the fault-plane leak rule)."""
+    set_anomaly_detector(None)

@@ -20,7 +20,7 @@ host slow?" and "is a host *gone*?", and they need different signals:
 from __future__ import annotations
 
 import time
-from typing import Any
+from typing import Any, Callable
 
 import numpy as np
 
@@ -52,6 +52,8 @@ class TrainingMonitor:
         can diverge.
       straggler_threshold: flag when the slowest host's mean step time
         exceeds this multiple of the cross-host mean.
+      clock: wall-clock source for the heartbeat stamp and its staleness
+        gauge (injectable — the watchdog's fake-clock test discipline).
     """
 
     def __init__(
@@ -61,6 +63,7 @@ class TrainingMonitor:
         interval: int = 50,
         cross_host: bool = True,
         straggler_threshold: float = 1.5,
+        clock: Callable[[], float] = time.time,
     ):
         if interval < 1:
             raise ValueError(f"interval must be >= 1, got {interval}")
@@ -68,8 +71,10 @@ class TrainingMonitor:
         self.interval = interval
         self.cross_host = cross_host
         self.straggler_threshold = straggler_threshold
+        self._clock = clock
         self._window: list[float] = []
         self._since_collect = 0
+        self._last_heartbeat: float | None = None
 
     @property
     def progress(self) -> int:
@@ -125,19 +130,51 @@ class TrainingMonitor:
         local_mean = sum(self._window) / len(self._window)
         import jax
 
+        # The run-health plane rides the SAME gather: when the goodput
+        # tracker is enabled (env/init-driven, hence SPMD-consistent —
+        # every process sends the same vector width), each host's
+        # goodput fraction travels next to its step time, and the
+        # cross-host min/max/mean cost zero extra collectives.
+        from . import goodput as _goodput
+
+        gp = _goodput.get_goodput_tracker()
+        local_goodput: float | None = None
+        if gp.enabled:
+            # Read the fraction directly (two attribute reads) — the
+            # full report() would pay jax.devices() + both MFU
+            # computations per collect only to discard them.
+            wall = gp.wall_seconds()
+            local_goodput = (
+                gp.bucket_seconds(_goodput.PRODUCTIVE_BUCKET) / wall
+                if wall > 0
+                else 0.0
+            )
         nproc = jax.process_count()
         if self.cross_host and nproc > 1:  # pragma: no cover - multihost only
-            # ONE gather of the scalar, statistics locally — three
-            # per-statistic host_allreduce calls would triple the
-            # blocking collective cost paid every interval.
+            # ONE gather of the (1- or 2-wide) vector, statistics
+            # locally — per-statistic host_allreduce calls would
+            # multiply the blocking collective cost paid every interval.
             from ..comm import host_allgather
 
-            means = host_allgather(np.float32(local_mean))
+            payload = [local_mean]
+            if local_goodput is not None:
+                payload.append(local_goodput)
+            gathered = host_allgather(np.float32(payload))
+            means = np.asarray(gathered).reshape(nproc, -1)[:, 0]
             mn = float(means.min())
             mx = float(means.max())
             mean = float(means.mean())
+            if local_goodput is not None:
+                fracs = np.asarray(gathered).reshape(nproc, -1)[:, 1]
+                gp_mn, gp_mx, gp_mean = (
+                    float(fracs.min()),
+                    float(fracs.max()),
+                    float(fracs.mean()),
+                )
         else:
             mn = mx = mean = local_mean
+            if local_goodput is not None:
+                gp_mn = gp_mx = gp_mean = local_goodput
         straggler = mean > 0 and mx > self.straggler_threshold * mean
         reg = self.registry
         reg.gauge("monitor.step_seconds_local_mean").set(local_mean)
@@ -145,13 +182,23 @@ class TrainingMonitor:
         reg.gauge("monitor.step_seconds_max").set(mx)
         reg.gauge("monitor.step_seconds_mean").set(mean)
         reg.gauge("monitor.straggler").set(float(straggler))
-        return {
+        summary = {
             "step_seconds_local_mean": local_mean,
             "step_seconds_min": mn,
             "step_seconds_max": mx,
             "step_seconds_mean": mean,
             "straggler": straggler,
         }
+        if local_goodput is not None:
+            reg.gauge("monitor.goodput_fraction_min").set(gp_mn)
+            reg.gauge("monitor.goodput_fraction_max").set(gp_mx)
+            reg.gauge("monitor.goodput_fraction_mean").set(gp_mean)
+            summary.update(
+                goodput_fraction_min=gp_mn,
+                goodput_fraction_max=gp_mx,
+                goodput_fraction_mean=gp_mean,
+            )
+        return summary
 
     def collect(self) -> dict[str, Any]:
         """Snapshot device memory, aggregate step times across hosts,
@@ -168,8 +215,18 @@ class TrainingMonitor:
         # The same tick feeds stall detection: `progress` reads this
         # counter, and the armed watchdog's global progress source is
         # bumped here too — heartbeat and watchdog share one truth.
+        # heartbeat_age_seconds makes the staleness readable from the
+        # record itself (no cross-line time_unix arithmetic): the gap
+        # since the PREVIOUS heartbeat, 0.0 on the first collect.
+        now = self._clock()
+        self.registry.gauge("monitor.heartbeat_age_seconds").set(
+            now - self._last_heartbeat
+            if self._last_heartbeat is not None
+            else 0.0
+        )
+        self._last_heartbeat = now
         self.registry.counter("monitor.heartbeat").inc()
-        self.registry.gauge("monitor.heartbeat_unix").set(time.time())
+        self.registry.gauge("monitor.heartbeat_unix").set(now)
         try:
             from .watchdog import notify_progress
 

@@ -63,10 +63,11 @@ _HIST_STAT_KEYS = ("sum", "min", "max", "mean", "last")
 
 # Every metric name the framework itself emits. Documentation for readers
 # of a JSONL stream — and, for the namespaces fully owned by the
-# fault-tolerance plane (see _CLOSED_NAMESPACES), an enforced contract:
-# a "fault."/"checkpoint." name outside this set is producer drift, not a
-# user metric. The older namespaces stay open (user code legitimately
-# mints train.my_metric etc.).
+# fault-tolerance and run-health planes (see _CLOSED_NAMESPACES), an
+# enforced contract: a "fault."/"checkpoint."/"goodput."/"anomaly." name
+# outside this set is producer drift, not a user metric. The older
+# namespaces stay open (user code legitimately mints train.my_metric
+# etc.).
 KNOWN_METRIC_NAMES = frozenset(
     {
         "comm.calls",
@@ -83,24 +84,45 @@ KNOWN_METRIC_NAMES = frozenset(
         "train.resumes",
         "fault.injected",
         "checkpoint.retries",
+        # Run-health plane (PR 7): goodput/badput wall-clock accounting
+        # (cumulative-seconds gauges labeled {bucket=...}), the
+        # productive fraction, live MFU over wall / over productive step
+        # time, and the anomaly trigger counter ({rule=...}).
+        "goodput.bucket_seconds",
+        "goodput.wall_seconds",
+        "goodput.fraction",
+        "goodput.updates",
+        "goodput.mfu",
+        "goodput.mfu_productive",
+        "anomaly.triggered",
         "monitor.heartbeat",
         "monitor.heartbeat_unix",
+        "monitor.heartbeat_age_seconds",
         "monitor.step_seconds_local_mean",
         "monitor.step_seconds_min",
         "monitor.step_seconds_max",
         "monitor.step_seconds_mean",
         "monitor.straggler",
+        "monitor.goodput_fraction_min",
+        "monitor.goodput_fraction_max",
+        "monitor.goodput_fraction_mean",
         "host.memory.peak_rss_bytes",
     }
 )
 
-_CLOSED_NAMESPACES = ("fault.", "checkpoint.")
+_CLOSED_NAMESPACES = ("fault.", "checkpoint.", "goodput.", "anomaly.")
 
 # The preemption trace event train_loop emits when it drains and exits on
 # SIGTERM/SIGINT: an instant ("i"/"I") carrying the update count it
 # banked — a span ("X") here would claim a duration preemption does not
 # have, so the validator rejects the wrong phase.
 PREEMPTION_EVENT = "train.preemption"
+
+# Anomaly trace events (AnomalyDetector triggers): "anomaly.<rule>"
+# instants carrying the rule name and the update count — same
+# instant-only contract as the preemption event (an anomaly is a point
+# in time, not a span), enforced by validate_trace_event.
+ANOMALY_EVENT_PREFIX = "anomaly."
 
 # Known optional bench keys -> required type(s). Unknown keys pass (new
 # fields must not break old validators); known keys with the wrong type
@@ -127,11 +149,24 @@ _BENCH_OPTIONAL: dict[str, tuple[type, ...]] = {
     # has no device_kind/n_chips) belongs to — part of the JSONL merge
     # key, so failures from different configs bank as distinct lines.
     "config": (str,),
+    # An MFU the harness computed but refused to report (>1.0: a broken
+    # clock or FLOPs estimate). Recorded instead of stderr-only printed
+    # so trajectory tooling can see the discard happened.
+    "mfu_discarded": (bool,),
 }
 
 
 def _is_number(x: object) -> bool:
     return isinstance(x, (int, float)) and not isinstance(x, bool)
+
+
+def _bench_type_ok(v: object, types: tuple[type, ...]) -> bool:
+    """Type check for _BENCH_OPTIONAL values. bool is a subclass of int,
+    so it is accepted ONLY where (bool,) is the declared type and
+    rejected everywhere a number is expected."""
+    if isinstance(v, bool):
+        return bool in types
+    return isinstance(v, types)
 
 
 def validate_metric(m: object, where: str = "metric") -> list[str]:
@@ -210,9 +245,7 @@ def validate_bench_record(rec: object) -> list[str]:
     if not _is_number(rec.get("vs_baseline")):
         errors.append("missing numeric 'vs_baseline'")
     for key, types in _BENCH_OPTIONAL.items():
-        if key in rec and not (
-            isinstance(rec[key], types) and not isinstance(rec[key], bool)
-        ):
+        if key in rec and not _bench_type_ok(rec[key], types):
             errors.append(
                 f"{key!r} must be {'/'.join(t.__name__ for t in types)}, "
                 f"got {type(rec[key]).__name__}"
@@ -425,6 +458,22 @@ def validate_trace_event(ev: object, where: str = "traceEvents[]") -> list[str]:
                 f"{where}: {PREEMPTION_EVENT!r} needs numeric args.step "
                 f"(the update count banked at preemption)"
             )
+    name = ev.get("name")
+    if isinstance(name, str) and name.startswith(ANOMALY_EVENT_PREFIX):
+        if ph not in ("i", "I"):
+            errors.append(
+                f"{where}: {name!r} must be an instant ('i'/'I'), "
+                f"got ph={ph!r} — an anomaly trigger is a point in time"
+            )
+        if not isinstance(args, dict) or not _is_number(args.get("step")):
+            errors.append(
+                f"{where}: {name!r} needs numeric args.step (the update "
+                f"count at the triggering flush)"
+            )
+        if not isinstance(args, dict) or not isinstance(
+            args.get("rule"), str
+        ) or not args.get("rule"):
+            errors.append(f"{where}: {name!r} needs args.rule (str)")
     return errors
 
 
@@ -543,6 +592,22 @@ def validate_watchdog_dump(rec: object) -> list[str]:
     if flush is not None:
         for e in validate_record(flush):
             errors.append(f"registry_flush: {e}")
+    anomaly = rec.get("anomaly")
+    if anomaly is not None:
+        # An anomaly diagnostics bundle: the same dump record with the
+        # triggering event attached (telemetry/anomaly.py).
+        if not isinstance(anomaly, dict):
+            errors.append(f"'anomaly' must be an object, got {anomaly!r}")
+        else:
+            if not isinstance(anomaly.get("rule"), str) or not anomaly.get(
+                "rule"
+            ):
+                errors.append("anomaly: missing 'rule' (str)")
+            if not isinstance(anomaly.get("action"), str):
+                errors.append("anomaly: missing 'action' (str)")
+            step = anomaly.get("step")
+            if step is not None and not _is_number(step):
+                errors.append("anomaly: 'step' must be a number or null")
     return errors
 
 

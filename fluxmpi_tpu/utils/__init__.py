@@ -13,6 +13,11 @@ from .manifest import (  # noqa: F401
     validate_manifest,
     write_manifest,
 )
+from .flops import (  # noqa: F401
+    chip_peak_flops,
+    cost_analysis_flops,
+    mfu,
+)
 from .profiling import profile_trace, step_timer  # noqa: F401
 from .ema import EMAState, ema_init, ema_params, ema_update  # noqa: F401
 from .precision import (  # noqa: F401

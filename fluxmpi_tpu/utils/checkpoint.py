@@ -86,6 +86,18 @@ _BACKOFF_CAP_S = 5.0
 _retry_sleep = time.sleep
 
 
+def _goodput_segment(name: str):
+    """Goodput-bucket context for checkpoint I/O — the run-health plane's
+    view of save/restore wall time (``checkpoint_save`` /
+    ``checkpoint_restore`` badput). A shared no-op when the tracker is
+    disabled (the default) or when called off the training driver thread
+    (an async background save overlaps training and is deliberately NOT
+    booked — see telemetry/goodput.py)."""
+    from ..telemetry import goodput as _goodput
+
+    return _goodput.segment(name)
+
+
 def _checkpointer():
     import orbax.checkpoint as ocp
 
@@ -450,7 +462,19 @@ def save_checkpoint(
     exponential backoff (env knobs in the module docstring). ``step``
     (optional) is recorded in the manifest — :class:`CheckpointManager`
     passes its step number.
+
+    Run health: the whole save is attributed to the goodput
+    ``checkpoint_save`` bucket when the tracker is enabled (synchronous
+    caller-thread saves only — an async background save overlaps
+    training and is deliberately not booked as badput).
     """
+    with _goodput_segment("checkpoint_save"):
+        _save_checkpoint_body(path, state, force=force, step=step)
+
+
+def _save_checkpoint_body(
+    path: str, state: Any, *, force: bool = True, step: int | None = None
+) -> None:
     path = os.path.abspath(path)
     layout = "sharded" if _is_sharded_tree(state) else "replicated"
     marker = _layout_marker_path(path)
@@ -634,7 +658,32 @@ def restore_checkpoint(
     ``mesh=``/``rule=`` (e.g. inspecting a pod FSDP checkpoint fully
     replicated on one host) is usually an accident, so the layout marker
     rejects it unless ``allow_layout_change=True``.
+
+    Run health: restore wall time lands in the goodput
+    ``checkpoint_restore`` bucket when the tracker is enabled (counted
+    once even inside ``train_loop``'s ``resume`` segment — outermost
+    attribution wins).
     """
+    with _goodput_segment("checkpoint_restore"):
+        return _restore_checkpoint_body(
+            path,
+            like,
+            root_rank=root_rank,
+            allow_layout_change=allow_layout_change,
+            mesh=mesh,
+            rule=rule,
+        )
+
+
+def _restore_checkpoint_body(
+    path: str,
+    like: Any,
+    *,
+    root_rank: int = 0,
+    allow_layout_change: bool = False,
+    mesh: Any = None,
+    rule: Any = None,
+) -> Any:
     if _faults.ARMED:
         _faults.check("ckpt.read")
     path = os.path.abspath(path)
@@ -904,28 +953,35 @@ class CheckpointManager:
 
         Aborts with :class:`~fluxmpi_tpu.errors.CheckpointDesyncError`
         (flight-recorder context dumped) when processes disagree on
-        ``step`` — checked on the caller thread, before any bytes move."""
-        self._check_step_agreement(step)
-        if self._executor is None or _is_sharded_tree(state):
-            self.wait_until_finished()
-            self._save_and_retain(step, state, force)
-            return
-        snapshot = _to_host_template(state)
-        # Submit under the lock so wait_until_finished always observes the
-        # newest pending future; the single-worker executor runs saves in
-        # submission order regardless. The wait on the *previous* save
-        # happens OUTSIDE the lock: if a background save wedges (e.g. one
-        # process never reaches a cross-process barrier), a lock-held wait
-        # would deadlock wait_until_finished behind it too (ADVICE r3). The
-        # post-submit wait still throttles to one queued snapshot and
-        # surfaces the previous save's errors to this caller.
-        with self._lock:
-            prev = self._pending
-            self._pending = self._executor.submit(
-                self._save_and_retain, step, snapshot, force
-            )
-        if prev is not None:
-            _wait_with_diagnostic(prev, "previous async checkpoint save")
+        ``step`` — checked on the caller thread, before any bytes move.
+
+        Goodput: the caller-thread cost — agreement check, host
+        snapshot, sync saves, and the throttling wait on the previous
+        async save — books into the ``checkpoint_save`` bucket; the
+        background write itself overlaps training and does not."""
+        with _goodput_segment("checkpoint_save"):
+            self._check_step_agreement(step)
+            if self._executor is None or _is_sharded_tree(state):
+                self.wait_until_finished()
+                self._save_and_retain(step, state, force)
+                return
+            snapshot = _to_host_template(state)
+            # Submit under the lock so wait_until_finished always observes
+            # the newest pending future; the single-worker executor runs
+            # saves in submission order regardless. The wait on the
+            # *previous* save happens OUTSIDE the lock: if a background
+            # save wedges (e.g. one process never reaches a cross-process
+            # barrier), a lock-held wait would deadlock
+            # wait_until_finished behind it too (ADVICE r3). The
+            # post-submit wait still throttles to one queued snapshot and
+            # surfaces the previous save's errors to this caller.
+            with self._lock:
+                prev = self._pending
+                self._pending = self._executor.submit(
+                    self._save_and_retain, step, snapshot, force
+                )
+            if prev is not None:
+                _wait_with_diagnostic(prev, "previous async checkpoint save")
 
     def _save_and_retain(self, step: int, state: Any, force: bool) -> None:
         save_checkpoint(self._step_path(step), state, force=force, step=step)
@@ -949,12 +1005,18 @@ class CheckpointManager:
                         shutil.rmtree(path, ignore_errors=True)
 
     def wait_until_finished(self) -> None:
-        """Block until any in-flight async save has committed."""
+        """Block until any in-flight async save has committed. The wait
+        is host time spent on checkpointing — goodput ``checkpoint_save``
+        badput (no-op booking when nothing is pending or the tracker is
+        off)."""
         with self._lock:
             pending = self._pending
             self._pending = None
         if pending is not None:
-            _wait_with_diagnostic(pending, "in-flight async checkpoint save")
+            with _goodput_segment("checkpoint_save"):
+                _wait_with_diagnostic(
+                    pending, "in-flight async checkpoint save"
+                )
 
     def read_manifest(self, step: int | None = None) -> dict[str, Any] | None:
         """The topology manifest of ``step`` (default: latest complete

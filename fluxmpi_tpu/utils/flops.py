@@ -1,0 +1,91 @@
+"""Shared FLOPs / MFU accounting for the bench harness and the live loop.
+
+Promoted out of ``bench.py`` (which had the only MFU implementation in
+the repo, usable solely offline) so the run-health plane
+(:mod:`fluxmpi_tpu.telemetry.goodput`) computes **live** MFU with the
+exact same peak table, cost-model fallback, and formula the bench
+reports — one implementation, two consumers, no drift between the
+offline number and the production one.
+
+Deliberately import-light: nothing here imports jax at module scope
+(``cost_analysis_flops`` only touches the compiled-step objects handed
+to it), so ``bench.py``'s parent driver — which must never boot a
+backend — can delegate to these helpers lazily from its children.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+__all__ = ["chip_peak_flops", "cost_analysis_flops", "mfu", "PEAK_FLOPS"]
+
+# Peak bf16 FLOPs/s per chip by device_kind substring (public spec
+# sheets). Ordered: first substring match wins, so the more specific
+# entries ("v5p") come before their prefixes would.
+PEAK_FLOPS: tuple[tuple[str, float], ...] = (
+    ("v6", 918e12),  # Trillium
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5 lite", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def chip_peak_flops(device_kind: str) -> float | None:
+    """Peak bf16 FLOPs/s for a device kind, or None when unknown (CPU,
+    future chips not yet in the table)."""
+    kind = device_kind.lower()
+    for sub, peak in PEAK_FLOPS:
+        if sub in kind:
+            return peak
+    return None
+
+
+def cost_analysis_flops(step: Any, state: Any, data: Any) -> float | None:
+    """FLOPs per compiled step call straight from XLA's cost model, if
+    exposed. ``step`` is anything with a ``.lower(state, data)`` (a
+    ``jax.jit`` wrapper or a :func:`~fluxmpi_tpu.parallel.make_train_step`
+    product); lowering does not execute or consume donated buffers, so
+    it is safe to call on the live pre-first-dispatch state."""
+    try:
+        compiled = step.lower(state, data).compile()
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0] if analysis else None
+        if analysis:
+            flops = float(analysis.get("flops", 0.0))
+            return flops if flops > 0 else None
+    except Exception:
+        pass
+    return None
+
+
+def mfu(
+    flops_per_step: float | None,
+    rate: float,
+    n_dev: int,
+    device_kind: str | None = None,
+    *,
+    peak: float | None = None,
+) -> float | None:
+    """Model FLOPs utilization per chip: FLOPs/step × steps/sec ÷
+    (chips × peak), rounded to 4 places.
+
+    Returns None when the FLOPs estimate or the peak is unknown
+    (``peak`` overrides the ``device_kind`` table lookup — the live
+    tracker's hook for tests and unlisted chips). The RAW value is
+    returned even when it exceeds 1.0 — an impossible number means a
+    broken clock or FLOPs estimate, and the *caller* decides whether to
+    discard it (``bench.py`` does, recording ``mfu_discarded``) or to
+    surface it."""
+    if not flops_per_step:
+        return None
+    if peak is None:
+        if device_kind is None:
+            return None
+        peak = chip_peak_flops(device_kind)
+    if peak is None or peak <= 0 or n_dev < 1:
+        return None
+    return round(flops_per_step * rate / (n_dev * peak), 4)

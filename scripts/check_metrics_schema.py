@@ -9,15 +9,20 @@ Usage:
 - ``*.jsonl`` files: every line must be a valid telemetry flush record
   (schema "fluxmpi_tpu.telemetry/v1"); a line carrying a ``bench`` key
   must also embed a valid bench record. Metric names in the
-  framework-owned ``fault.`` / ``checkpoint.`` namespaces must come from
-  ``schema.KNOWN_METRIC_NAMES`` (``fault.injected``,
-  ``checkpoint.retries``; ``train.resumes`` and the
-  ``train.preemption`` trace instant are validated the same way) —
+  framework-owned ``fault.`` / ``checkpoint.`` / ``goodput.`` /
+  ``anomaly.`` namespaces must come from ``schema.KNOWN_METRIC_NAMES``
+  (``fault.injected``, ``checkpoint.retries``, the run-health plane's
+  ``goodput.bucket_seconds``/``goodput.mfu``/``anomaly.triggered``
+  family; ``train.resumes`` and the ``train.preemption`` /
+  ``anomaly.<rule>`` trace instants are validated the same way) —
   producer drift there fails the check.
 - ``*.json`` files carrying ``"schema": "fluxmpi_tpu.trace/v1"``:
   dispatched on ``kind`` — a trace export (``Tracer.export`` /
   ``scripts/merge_traces.py`` output), a flight-recorder dump, or a
-  watchdog hang dump.
+  watchdog hang dump. Anomaly diagnostics bundles
+  (``fluxmpi_anomaly.<process>.json``, written by the
+  :class:`AnomalyDetector` on trigger) are watchdog-dump-kind records
+  with an extra ``anomaly`` section and validate through the same path.
 - ``*.json`` files carrying ``"schema": "fluxmpi_tpu.manifest/v1"``
   (the ``<step>.manifest.json`` topology sidecar every checkpoint save
   writes): validated against the manifest schema — leaf

@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""Per-run goodput/badput breakdown from telemetry JSONL streams.
+
+Reads the per-host JSONL files a run emitted (``init(telemetry=...)`` /
+``FLUXMPI_TPU_TELEMETRY`` with the goodput plane enabled —
+``init(goodput=True)`` / ``FLUXMPI_TPU_GOODPUT=1``), takes each
+process's LAST record carrying ``goodput.*`` metrics (the gauges are
+cumulative, so the newest line is the run total), and prints the
+wall-clock attribution the fleet is managed on:
+
+    $ python scripts/goodput_report.py run.*.jsonl
+    host 0: wall 124.7s  goodput 91.2%  MFU 0.412  updates 9600
+      step                  113.7s   91.2%
+      compile                 6.1s    4.9%
+      checkpoint_save         2.4s    1.9%
+      data_stall              1.1s    0.9%
+      host_idle               1.4s    1.1%
+    run: 1 host stream(s)  wall 124.7s  goodput 91.2%  mean MFU 0.412
+
+Usage:
+    python scripts/goodput_report.py FILE [FILE ...] [--json]
+
+``--json`` prints one machine-readable JSON object instead of the table.
+
+Exit codes: 0 = goodput data found and reported; 1 = inputs readable but
+NO goodput metrics anywhere (the plane was off — nothing to report);
+2 = a file was missing/unreadable. A torn or corrupt LINE (a host killed
+mid-write — the very post-mortem this report serves) is skipped with a
+stderr warning, never fatal.
+
+Stdlib-only, no jax, no package import — runnable anywhere the JSONL
+landed (same contract as scripts/check_metrics_schema.py, which
+validates the same streams).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any
+
+
+def _extract_goodput(record: dict) -> dict[str, Any] | None:
+    """Pull the goodput.* gauges out of one telemetry flush record;
+    None when the record carries none (the plane was off at that
+    flush)."""
+    metrics = record.get("metrics")
+    if not isinstance(metrics, list):
+        return None
+    out: dict[str, Any] = {"buckets": {}}
+    found = False
+    for m in metrics:
+        if not isinstance(m, dict):
+            continue
+        name = m.get("name")
+        if not isinstance(name, str) or not name.startswith("goodput."):
+            continue
+        value = m.get("value")
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            continue
+        found = True
+        if name == "goodput.bucket_seconds":
+            bucket = (m.get("labels") or {}).get("bucket")
+            if isinstance(bucket, str) and bucket:
+                out["buckets"][bucket] = float(value)
+        elif name == "goodput.wall_seconds":
+            out["wall_seconds"] = float(value)
+        elif name == "goodput.fraction":
+            out["goodput_fraction"] = float(value)
+        elif name == "goodput.updates":
+            out["updates"] = int(value)
+        elif name == "goodput.mfu":
+            out["mfu"] = float(value)
+        elif name == "goodput.mfu_productive":
+            out["mfu_productive"] = float(value)
+    return out if found else None
+
+
+def _read_streams(paths: list[str]) -> tuple[dict[int, dict], list[str]]:
+    """Last goodput-carrying record per process across all files.
+    Returns ``(per_process, errors)`` — errors are fatal (exit 2)."""
+    per_process: dict[int, dict] = {}
+    errors: list[str] = []
+    for path in paths:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                content = f.read()
+        except OSError as exc:
+            errors.append(f"{path}: {exc}")
+            continue
+        for i, line in enumerate(content.splitlines(), 1):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as exc:
+                # A torn final line is EXPECTED in the post-mortem this
+                # report exists for (a host killed mid-write); the
+                # complete records around it still carry the cumulative
+                # totals — warn and report, never refuse the fleet's
+                # data over one partial line.
+                print(
+                    f"goodput_report: skipping {path}:{i}: not JSON: {exc}",
+                    file=sys.stderr,
+                )
+                continue
+            if not isinstance(rec, dict):
+                continue
+            gp = _extract_goodput(rec)
+            if gp is None:
+                continue
+            proc = rec.get("process")
+            proc = proc if isinstance(proc, int) else 0
+            gp["process"] = proc
+            gp["time_unix"] = rec.get("time_unix")
+            # Later lines supersede earlier ones: the gauges are
+            # cumulative run totals, newest flush wins.
+            per_process[proc] = gp
+    return per_process, errors
+
+
+def _aggregate(per_process: dict[int, dict]) -> dict[str, Any]:
+    hosts = [per_process[p] for p in sorted(per_process)]
+    walls = [h.get("wall_seconds", 0.0) for h in hosts]
+    steps = [h.get("buckets", {}).get("step", 0.0) for h in hosts]
+    mfus = [h["mfu"] for h in hosts if h.get("mfu") is not None]
+    total_wall = sum(walls)
+    buckets: dict[str, float] = {}
+    for h in hosts:
+        for name, seconds in h.get("buckets", {}).items():
+            buckets[name] = buckets.get(name, 0.0) + seconds
+    return {
+        "hosts": hosts,
+        "host_count": len(hosts),
+        "wall_seconds": total_wall,
+        "buckets": buckets,
+        # Fleet goodput: productive host-seconds over total host-seconds
+        # (hosts weighted by their wall, not a plain mean of fractions).
+        "goodput_fraction": (
+            sum(steps) / total_wall if total_wall > 0 else 0.0
+        ),
+        "mean_mfu": sum(mfus) / len(mfus) if mfus else None,
+        "updates": max(
+            (h.get("updates", 0) for h in hosts), default=0
+        ),
+    }
+
+
+def _print_host(host: dict) -> None:
+    wall = host.get("wall_seconds", 0.0)
+    frac = host.get("goodput_fraction", 0.0)
+    mfu = host.get("mfu")
+    line = (
+        f"host {host['process']}: wall {wall:.1f}s  "
+        f"goodput {100.0 * frac:.1f}%"
+    )
+    if mfu is not None:
+        line += f"  MFU {mfu:.4f}"
+    if host.get("mfu_productive") is not None:
+        line += f"  (productive MFU {host['mfu_productive']:.4f})"
+    if host.get("updates") is not None:
+        line += f"  updates {host.get('updates')}"
+    print(line)
+    buckets = host.get("buckets", {})
+    for name in sorted(buckets, key=lambda n: -buckets[n]):
+        seconds = buckets[name]
+        share = 100.0 * seconds / wall if wall > 0 else 0.0
+        print(f"  {name:<20} {seconds:>9.2f}s  {share:>5.1f}%")
+
+
+def main(argv: list[str]) -> int:
+    parser = argparse.ArgumentParser(
+        description="Per-run goodput/badput breakdown from telemetry JSONL"
+    )
+    parser.add_argument("files", nargs="+", help="telemetry JSONL file(s)")
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    args = parser.parse_args(argv)
+    per_process, errors = _read_streams(args.files)
+    for e in errors:
+        print(e, file=sys.stderr)
+    if errors:
+        return 2
+    if not per_process:
+        print(
+            "goodput_report: no goodput.* metrics in "
+            f"{len(args.files)} file(s) — was the run started with "
+            "FLUXMPI_TPU_GOODPUT=1 / init(goodput=True)?",
+            file=sys.stderr,
+        )
+        return 1
+    agg = _aggregate(per_process)
+    if args.json:
+        print(json.dumps(agg))
+        return 0
+    for host in agg["hosts"]:
+        _print_host(host)
+    line = (
+        f"run: {agg['host_count']} host stream(s)  "
+        f"wall {agg['wall_seconds']:.1f}s  "
+        f"goodput {100.0 * agg['goodput_fraction']:.1f}%"
+    )
+    if agg["mean_mfu"] is not None:
+        line += f"  mean MFU {agg['mean_mfu']:.4f}"
+    print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
