@@ -1,0 +1,128 @@
+"""fluxlint — the repo's AST-based SPMD / hot-path invariant checker.
+
+Pure stdlib (no jax): enforces statically the contracts the last several
+PRs kept re-fixing by hand — every rank executes the same collective
+sequence, instrumentation stays behind the zero-cost-when-off guard, and
+the string registries (metric names, fault sites, ``FLUXMPI_TPU_*`` env
+vars) stay in sync with ``telemetry/schema.py``, ``faults.KNOWN_SITES``,
+and the docs table. Run it via ``scripts/fluxlint.py`` (which loads this
+package standalone, no backend boot) or in-process::
+
+    from fluxmpi_tpu.analysis import lint_repo
+    report = lint_repo("/path/to/repo", ["fluxmpi_tpu", "scripts"])
+    assert report.exit_code == 0, report.text()
+
+Rule catalogue, suppression (``# fluxlint: disable=<rule>``) and
+baseline workflow: docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable
+
+from .context import ProjectContext, load_schema_module
+from .core import (
+    BASELINE_BASENAME,
+    JSON_SCHEMA,
+    Baseline,
+    Finding,
+    ModuleSource,
+    Report,
+    Rule,
+    lint_modules,
+    parse_files,
+)
+from .rules import DEFAULT_HOT_FUNCTIONS, default_rules
+
+__all__ = [
+    "BASELINE_BASENAME",
+    "JSON_SCHEMA",
+    "Baseline",
+    "DEFAULT_HOT_FUNCTIONS",
+    "Finding",
+    "ModuleSource",
+    "ProjectContext",
+    "Report",
+    "Rule",
+    "default_rules",
+    "lint_modules",
+    "lint_repo",
+    "lint_source",
+    "load_schema_module",
+    "collect_py_files",
+]
+
+
+def collect_py_files(targets: Iterable[str], repo_root: str) -> list[str]:
+    """Absolute paths of the ``.py`` files under ``targets`` (files or
+    directories, absolute or repo-root-relative), ``__pycache__``
+    pruned, sorted for stable reports."""
+    out: list[str] = []
+    for target in targets:
+        path = (
+            target
+            if os.path.isabs(target)
+            else os.path.join(repo_root, target)
+        )
+        if os.path.isfile(path):
+            out.append(path)
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for name in filenames:
+                if name.endswith(".py"):
+                    out.append(os.path.join(dirpath, name))
+    return sorted(out)
+
+
+def lint_repo(
+    repo_root: str,
+    targets: Iterable[str] = ("fluxmpi_tpu", "scripts"),
+    *,
+    baseline_path: str | None = None,
+    context: ProjectContext | None = None,
+    rules: Iterable[Rule] | None = None,
+) -> Report:
+    """Lint ``targets`` under ``repo_root`` with the default rule set,
+    project context, and baseline (``.fluxlint-baseline.json`` at the
+    repo root unless overridden)."""
+    repo_root = os.path.abspath(repo_root)
+    ctx = context if context is not None else ProjectContext.load(repo_root)
+    files = collect_py_files(targets, repo_root)
+
+    def read(path: str) -> str:
+        with open(path, encoding="utf-8") as f:
+            return f.read()
+
+    modules, errors = parse_files(files, repo_root, read)
+    if baseline_path is None:
+        baseline_path = os.path.join(repo_root, BASELINE_BASENAME)
+    # An empty baseline_path means "no baseline" (every finding active).
+    baseline = Baseline.load(baseline_path) if baseline_path else None
+    report = lint_modules(
+        modules,
+        rules if rules is not None else default_rules(),
+        ctx,
+        baseline,
+    )
+    report.unreadable.extend(errors)
+    return report
+
+
+def lint_source(
+    source: str,
+    path: str,
+    context: ProjectContext,
+    rules: Iterable[Rule] | None = None,
+    baseline: Baseline | None = None,
+) -> Report:
+    """Lint one in-memory source snippet as if it lived at ``path``
+    (repo-relative) — the fixture-test entry point."""
+    module = ModuleSource(path, source)
+    return lint_modules(
+        [module],
+        rules if rules is not None else default_rules(),
+        context,
+        baseline,
+    )

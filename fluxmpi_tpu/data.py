@@ -807,13 +807,20 @@ class DistributedDataLoader:
 
     def __iter__(self) -> Iterator[Any]:
         it = self._timed_batches()
-        depth = _telemetry_registry().gauge("data.prefetch_depth")
+        # Per-batch gauge updates ride behind the registry's enabled
+        # guard, resolved once per epoch — with telemetry off the yield
+        # loop pays one None check per batch, no registry-handle lookup
+        # (the same zero-cost-when-off contract as _timed_batches;
+        # fluxlint rule unguarded-hot-path-instrumentation).
+        reg = _telemetry_registry()
+        depth = reg.gauge("data.prefetch_depth") if reg.enabled else None
         # `_cursor` counts batches HANDED TO THE CONSUMER — incremented at
         # the yield, never when the prefetcher reads ahead — so a
         # state_dict() taken at a batch boundary names exactly the batches
         # the training loop consumed (the resume contract).
         if not self.prefetch:
-            depth.set(0)
+            if depth is not None:
+                depth.set(0)
             for batch in it:
                 self._cursor += 1
                 yield batch
@@ -829,11 +836,13 @@ class DistributedDataLoader:
         for batch in it:
             queue.append(batch)
             if len(queue) > self.prefetch:
-                depth.set(len(queue) - 1)
+                if depth is not None:
+                    depth.set(len(queue) - 1)
                 self._cursor += 1
                 yield queue.popleft()
         while queue:
-            depth.set(len(queue) - 1)
+            if depth is not None:
+                depth.set(len(queue) - 1)
             self._cursor += 1
             yield queue.popleft()
 

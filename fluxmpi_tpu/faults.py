@@ -37,6 +37,16 @@ A firing site raises :class:`FaultInjectedError` (re-exported from
 (labeled by site) in the default telemetry registry, and lands a
 ``fault.injected`` instant on the trace timeline when tracing is on.
 
+The woven sites are registered in :data:`KNOWN_SITES` — the canonical
+registry that schedule validation and the fluxlint
+``unregistered-fault-site`` rule (docs/static_analysis.md) check
+against. :func:`install`/:class:`scope` raise on a schedule entry
+naming an unregistered site (naming the nearest registered one);
+:func:`configure` — the ``FLUXMPI_TPU_FAULTS``/``init(faults=)`` path —
+warns instead, so a typo degrades the schedule rather than crashing
+startup. User code weaving its own sites declares them with
+:func:`register_site`.
+
 **Schedule grammar** — set via :func:`install` / :func:`configure` or the
 ``FLUXMPI_TPU_FAULTS`` env var; comma-separated entries::
 
@@ -70,7 +80,9 @@ no RNG draws (unit-tested by monkeypatching :func:`check` to explode).
 
 from __future__ import annotations
 
+import difflib
 import os
+import warnings
 from typing import Any, Iterable
 
 import numpy as np
@@ -84,6 +96,9 @@ __all__ = [
     "FaultInjectedError",
     "FaultSpec",
     "ARMED",
+    "KNOWN_SITES",
+    "register_site",
+    "registered_sites",
     "install",
     "clear",
     "configure",
@@ -94,6 +109,71 @@ __all__ = [
 ]
 
 _ENV_VAR = "FLUXMPI_TPU_FAULTS"
+
+# The canonical site registry: every ``check("...")`` literal woven into
+# the framework (the table in the module docstring) — the single source
+# the schedule validation below and the fluxlint unregistered-fault-site
+# rule check against. Kept a plain literal on purpose: the linter reads
+# it from this file's AST without importing the package. Extend at
+# runtime with :func:`register_site` (user code weaving its own sites).
+KNOWN_SITES = frozenset(
+    {
+        "comm.allreduce",
+        "comm.bcast",
+        "comm.reduce",
+        "comm.barrier",
+        "comm.host_allreduce",
+        "comm.host_allgather",
+        "comm.host_bcast",
+        "data.fetch",
+        "ckpt.write",
+        "ckpt.manifest",
+        "ckpt.commit",
+        "ckpt.read",
+        "elastic.restore",
+    }
+)
+
+_extra_sites: set[str] = set()
+
+
+def register_site(site: str) -> str:
+    """Register a user-woven fault site so schedules naming it pass
+    validation. Returns the site (register-and-use idiom). Framework
+    sites live in :data:`KNOWN_SITES`."""
+    if not site or not isinstance(site, str):
+        raise ValueError(f"fault site must be a non-empty string, got {site!r}")
+    _extra_sites.add(site)
+    return site
+
+
+def registered_sites() -> frozenset[str]:
+    """Every valid schedule site: the framework registry plus
+    :func:`register_site` additions."""
+    return KNOWN_SITES | _extra_sites
+
+
+def _validate_sites(specs: "list[FaultSpec]", *, strict: bool) -> None:
+    """Reject (or warn about) schedule entries naming unregistered sites
+    — a typo'd site used to be silently accepted and simply never fired.
+    ``strict`` raises (explicit :func:`install` / :class:`scope`);
+    :func:`configure` warns instead, so a bad ``FLUXMPI_TPU_FAULTS``
+    degrades the schedule rather than crashing init."""
+    sites = registered_sites()
+    for spec in specs:
+        if spec.site in sites:
+            continue
+        close = difflib.get_close_matches(spec.site, sites, n=1)
+        hint = f"; nearest registered site: {close[0]!r}" if close else ""
+        message = (
+            f"unknown fault site {spec.site!r} in schedule entry "
+            f"{spec!s}{hint} — the entry can never fire; see "
+            f"faults.KNOWN_SITES, or faults.register_site() for "
+            f"user-woven sites"
+        )
+        if strict:
+            raise ValueError(message)
+        warnings.warn(message, stacklevel=3)
 
 # The fast-guard: True iff a schedule is installed. Woven sites read this
 # ONE module attribute before doing anything else; everything below this
@@ -228,13 +308,24 @@ def _coerce(spec: Any) -> list[FaultSpec]:
     )
 
 
-def install(spec: Any, *, append: bool = False) -> list[FaultSpec]:
+def install(
+    spec: Any, *, append: bool = False, allow_unknown: bool = False
+) -> list[FaultSpec]:
     """Arm a fault schedule (replacing any current one unless ``append``).
     Accepts the grammar string, a :class:`FaultSpec`, or a list; returns
     the installed specs. Hit counters reset on replace, persist on append
-    (an appended entry sees the site's full history)."""
+    (an appended entry sees the site's full history).
+
+    Entries naming a site outside :func:`registered_sites` raise
+    :class:`ValueError` (naming the nearest registered site) BEFORE any
+    armed state changes — a typo'd site was previously accepted and
+    silently never fired. ``allow_unknown=True`` skips the check
+    (:func:`configure` uses it after warning; deliberate schedules
+    against not-yet-woven sites should prefer :func:`register_site`)."""
     global _active, ARMED, _configured_spec
     specs = _coerce(spec)
+    if not allow_unknown:
+        _validate_sites(specs, strict=True)
     _configured_spec = None  # direct installs supersede configure()'s
     if append and _active is not None:
         merged = _Schedule(_active.specs + specs)
@@ -294,7 +385,11 @@ def configure(spec: Any = None) -> list[FaultSpec]:
     canon = ",".join(str(s) for s in specs)
     if _active is not None and canon == _configured_spec:
         return active()  # idempotent replay: keep the live counters
-    install(specs)
+    # Warn (not raise) on unknown sites: a typo'd FLUXMPI_TPU_FAULTS
+    # should degrade the schedule, not crash init() — the entry still
+    # installs so injected_count()/active() reflect what was asked for.
+    _validate_sites(specs, strict=False)
+    install(specs, allow_unknown=True)
     _configured_spec = canon
     return active()
 
@@ -345,10 +440,11 @@ class scope:
     def __enter__(self) -> "scope":
         global _active, ARMED
         specs = _coerce(self.spec)  # validate BEFORE touching armed state
+        _validate_sites(specs, strict=True)
         self._saved = _active
         self._saved_spec = _configured_spec
         _active = None
-        install(specs)
+        install(specs, allow_unknown=True)  # validated above
         return self
 
     def __exit__(self, *exc: Any) -> None:

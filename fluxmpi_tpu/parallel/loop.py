@@ -424,14 +424,21 @@ def train_loop(
         # tells us, BEFORE any bytes move, whether the checkpoint comes
         # from a different world — and whether it predates manifests, in
         # which case the restore template must use the PR 5 payload
-        # shape (no loader-geometry keys to miss).
+        # shape (no loader-geometry keys to miss). Read+validated ONCE
+        # here and passed through to restore (None included: "looked,
+        # absent"), killing the former per-resume double read; managers
+        # without read_manifest keep the old read-inside-restore path.
         manifest = None
         read_manifest = getattr(checkpoint, "read_manifest", None)
         if read_manifest is not None:
             manifest = read_manifest()
+            restore_kwargs = {"manifest": manifest}
+        else:
+            restore_kwargs = {}
         try:
             ckpt_step, restored = checkpoint.restore(
-                _payload(state, legacy_loader=manifest is None)
+                _payload(state, legacy_loader=manifest is None),
+                **restore_kwargs,
             )
         except FileNotFoundError:
             restored = None  # empty directory: fresh start, same command
@@ -447,7 +454,9 @@ def train_loop(
             # the geometry-carrying loader dict, and the legacy template
             # just mismatched its structure. Retry with the full shape
             # before declaring the checkpoint unrestorable.
-            ckpt_step, restored = checkpoint.restore(_payload(state))
+            ckpt_step, restored = checkpoint.restore(
+                _payload(state), **restore_kwargs
+            )
         if restored is not None:
             state = restored["state"]
             updates = int(restored["loop"]["updates"])

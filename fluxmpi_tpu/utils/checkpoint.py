@@ -625,6 +625,14 @@ def _save_checkpoint_body(
     _process_barrier(f"ckpt_save:{path}")
 
 
+# Sentinel for restore_checkpoint(manifest=...): "not provided — read it
+# from disk". Distinct from None, which means "known absent: the caller
+# already looked and found no manifest" (train_loop's resume path reads
+# the manifest once up front and passes it through, killing the PR 6
+# double read+validate per resume).
+_MANIFEST_UNREAD = object()
+
+
 def restore_checkpoint(
     path: str,
     like: Any,
@@ -633,6 +641,7 @@ def restore_checkpoint(
     allow_layout_change: bool = False,
     mesh: Any = None,
     rule: Any = None,
+    manifest: Any = _MANIFEST_UNREAD,
 ) -> Any:
     """Read the checkpoint at ``path`` and return it synchronized from
     ``root_rank`` and laid out like ``like`` (replicated over the mesh).
@@ -663,6 +672,13 @@ def restore_checkpoint(
     ``checkpoint_restore`` bucket when the tracker is enabled (counted
     once even inside ``train_loop``'s ``resume`` segment — outermost
     attribution wins).
+
+    ``manifest``: a caller that already read+validated the topology
+    manifest (``CheckpointManager.read_manifest`` / ``train_loop``'s
+    resume bring-up) passes it here — including an explicit ``None``
+    for "looked and absent" — so the restore does not read and
+    re-validate the sidecar a second time. Left unset, the manifest is
+    read from disk as before.
     """
     with _goodput_segment("checkpoint_restore"):
         return _restore_checkpoint_body(
@@ -672,6 +688,7 @@ def restore_checkpoint(
             allow_layout_change=allow_layout_change,
             mesh=mesh,
             rule=rule,
+            manifest=manifest,
         )
 
 
@@ -683,11 +700,16 @@ def _restore_checkpoint_body(
     allow_layout_change: bool = False,
     mesh: Any = None,
     rule: Any = None,
+    manifest: Any = _MANIFEST_UNREAD,
 ) -> Any:
     if _faults.ARMED:
         _faults.check("ckpt.read")
     path = os.path.abspath(path)
-    man = _manifest.read_manifest(path)
+    man = (
+        _manifest.read_manifest(path)
+        if manifest is _MANIFEST_UNREAD
+        else manifest
+    )
     if man is None:
         _warn_once(
             _warned_missing_manifest,
@@ -1038,11 +1060,14 @@ class CheckpointManager:
         allow_layout_change: bool = False,
         mesh: Any = None,
         rule: Any = None,
+        manifest: Any = _MANIFEST_UNREAD,
     ) -> tuple[int, Any]:
         """Restore ``step`` (default: latest complete) as
         ``(step, state)``; raises ``FileNotFoundError`` when nothing is
-        restorable. ``allow_layout_change``, ``mesh`` and ``rule``
-        forward to :func:`restore_checkpoint` (elastic cross-family /
+        restorable. ``allow_layout_change``, ``mesh``, ``rule`` and
+        ``manifest`` (a sidecar the caller already read via
+        :meth:`read_manifest` — skips the second read+validate) forward
+        to :func:`restore_checkpoint` (elastic cross-family /
         cross-topology restore)."""
         self.wait_until_finished()
         if step is None:
@@ -1054,7 +1079,7 @@ class CheckpointManager:
         return step, restore_checkpoint(
             self._step_path(step), like,
             allow_layout_change=allow_layout_change,
-            mesh=mesh, rule=rule,
+            mesh=mesh, rule=rule, manifest=manifest,
         )
 
     def close(self) -> None:

@@ -52,11 +52,17 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _load_schema():
-    path = os.path.join(_REPO, "fluxmpi_tpu", "telemetry", "schema.py")
-    spec = importlib.util.spec_from_file_location("_fluxmpi_schema", path)
+    # One loader for "the schema module, by file path, without booting
+    # jax": fluxmpi_tpu/analysis/context.py owns it (fluxlint checks
+    # metric-name and env-var drift against the same source), and this
+    # script borrows it instead of keeping a second copy.
+    path = os.path.join(_REPO, "fluxmpi_tpu", "analysis", "context.py")
+    spec = importlib.util.spec_from_file_location(
+        "_fluxmpi_analysis_context", path
+    )
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    return mod
+    return mod.load_schema_module(_REPO)
 
 
 def _bench_record_from(data: dict) -> dict | None:

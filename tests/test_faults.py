@@ -30,6 +30,23 @@ def _clean_faults():
     faults.clear()
 
 
+# The grammar/semantics tests below arm synthetic sites that are not
+# woven into the framework; install()/scope() now validate against
+# faults.KNOWN_SITES, so register them the way user-woven sites would be.
+for _site in (
+    "site.a",
+    "site.b",
+    "site.p",
+    "site.c",
+    "site.r",
+    "site.x",
+    "site.m",
+    "outer.site",
+    "inner.site",
+):
+    faults.register_site(_site)
+
+
 # ---------------------------------------------------------------------------
 # Grammar / schedule semantics
 # ---------------------------------------------------------------------------
@@ -226,6 +243,68 @@ def test_armed_comm_site_fires_deterministically(world):
         fm.allreduce(x)  # spent
         # bcast is a different site: untouched.
         fm.bcast(x)
+
+
+@pytest.mark.parametrize(
+    "site,call",
+    [
+        ("comm.allreduce", lambda x: fm.allreduce(x)),
+        ("comm.bcast", lambda x: fm.bcast(x)),
+        ("comm.reduce", lambda x: fm.reduce(x)),
+        ("comm.barrier", lambda x: fm.barrier()),
+        ("comm.host_allreduce", lambda x: fm.host_allreduce(np.float32(1))),
+        ("comm.host_allgather", lambda x: fm.host_allgather(np.float32(1))),
+        ("comm.host_bcast", lambda x: fm.host_bcast(np.float32(1))),
+    ],
+)
+def test_every_comm_site_is_injectable(world, site, call):
+    # Every comm.* entry of faults.KNOWN_SITES has a live trigger — the
+    # coverage contract the fluxlint unregistered-fault-site rule greps
+    # this file for (each registered site must be exercised somewhere in
+    # tests/).
+    x = np.arange(8, dtype=np.float32)
+    with faults.scope(site + "@step=1"):
+        with pytest.raises(FaultInjectedError, match=site):
+            call(x)
+    call(x)  # disarmed: clean
+
+
+# ---------------------------------------------------------------------------
+# Site-registry validation (install raises, configure warns)
+# ---------------------------------------------------------------------------
+
+
+def test_install_rejects_unknown_site_naming_nearest():
+    with pytest.raises(ValueError, match=r"ckpt\.write"):
+        faults.install("ckpt.wrte@step=1")  # typo: nearest is named
+    assert not faults.ARMED  # nothing armed by the failed install
+
+
+def test_scope_rejects_unknown_site_and_preserves_schedule():
+    faults.install("site.a@step=1")
+    with pytest.raises(ValueError, match="unknown fault site"):
+        with faults.scope("data.fetchh@step=1"):
+            pass
+    # The failed scope never touched the armed schedule.
+    assert [s.site for s in faults.active()] == ["site.a"]
+    assert faults.ARMED
+
+
+def test_configure_warns_on_unknown_env_site(monkeypatch):
+    # A typo'd FLUXMPI_TPU_FAULTS degrades with a warning naming the
+    # nearest registered site — it must not crash init().
+    monkeypatch.setenv("FLUXMPI_TPU_FAULTS", "comm.allredcue@step=1")
+    with pytest.warns(UserWarning, match=r"comm\.allreduce"):
+        specs = faults.configure()
+    assert [s.site for s in specs] == ["comm.allredcue"]  # installed as asked
+
+
+def test_register_site_extends_the_registry():
+    site = faults.register_site("userlib.flush")
+    assert site in faults.registered_sites()
+    faults.install("userlib.flush@step=1")  # no raise: registered
+    with pytest.raises(FaultInjectedError):
+        faults.check("userlib.flush")
 
 
 def test_armed_data_fetch_site_fires(world):

@@ -314,6 +314,34 @@ def test_resume_counts_metrics_and_validation(world, tmp_path):
                    save_every=0)
 
 
+def test_resume_reads_manifest_exactly_once(world, tmp_path, monkeypatch):
+    """train_loop(resume=True) reads+validates the topology sidecar ONCE
+    and passes it through to restore — the PR 6 'known cost' double read
+    (read_manifest in the loop, read_manifest again inside
+    restore_checkpoint) is gone."""
+    from fluxmpi_tpu.utils import manifest as manifest_mod
+
+    loss_fn, opt, fresh, loader = _pieces(world)
+    step = make_train_step(loss_fn, opt, mesh=world)
+    mgr = CheckpointManager(str(tmp_path / "run"), async_save=False)
+    train_loop(step, fresh(), loader(), steps=4,
+               checkpoint=mgr, save_every=2)
+
+    calls = []
+    real = manifest_mod.read_manifest
+
+    def counting(path):
+        calls.append(path)
+        return real(path)
+
+    monkeypatch.setattr(manifest_mod, "read_manifest", counting)
+    mgr2 = CheckpointManager(str(tmp_path / "run"), async_save=False)
+    _, summary = train_loop(step, fresh(), loader(), steps=8,
+                            checkpoint=mgr2, save_every=2, resume=True)
+    assert summary["resumed_from"] is not None
+    assert len(calls) == 1, calls
+
+
 def test_resume_epoch_accounting_at_exact_boundary(world, tmp_path):
     """A save landing exactly at the end of a pass must bank that pass
     exactly once — via the in-loop save (crash path) AND via the
