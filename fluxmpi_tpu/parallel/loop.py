@@ -55,6 +55,7 @@ from __future__ import annotations
 
 import contextlib
 import time
+import warnings
 from collections import deque
 from typing import Any, Iterable
 
@@ -107,6 +108,32 @@ def _stall_timed(it: Any, gp: Any) -> Iterable[Any]:
             return
         gp.add("data_stall", clock() - t0)
         yield batch
+
+
+def _maybe_oom_forensics(exc: BaseException, registry: Any) -> None:
+    """On an XLA ``RESOURCE_EXHAUSTED`` escaping the dispatch loop,
+    write the HBM forensics bundle (live-array census, per-device
+    stats, peak watermark, watchdog dump sections) before the caller
+    re-raises — the record of what was resident must survive the
+    process. Any other exception passes through untouched; error path
+    only, so the fully-off hot loop never reaches this."""
+    from ..telemetry import memory as _memory
+
+    if not _memory.is_oom_error(exc):
+        return
+    try:
+        path = _memory.write_oom_bundle(exc, registry=registry)
+        warnings.warn(
+            f"device RESOURCE_EXHAUSTED: OOM forensics bundle written to "
+            f"{path} (live-array census + HBM watermark); see "
+            f"docs/observability.md 'Device plane'",
+            stacklevel=3,
+        )
+    except Exception as bundle_exc:  # forensics must never mask the OOM
+        warnings.warn(
+            f"OOM forensics bundle write failed: {bundle_exc!r}",
+            stacklevel=3,
+        )
 
 
 def _batch_examples(batch: Any, scan_steps: int) -> int:
@@ -229,6 +256,20 @@ def train_loop(
     cleanly with ``summary["preempted"] = True`` — a
     ``train.preemption`` instant lands on the trace timeline.
 
+    Device plane: with a
+    :class:`~fluxmpi_tpu.telemetry.CompileMonitor` installed
+    (``init(compileplane=True)`` / ``FLUXMPI_TPU_COMPILEPLANE=1``) the
+    loop tags its hot step for retrace attribution and syncs
+    ``compile.*`` metrics at every flush; compile events after the
+    first flush (the warmup boundary) feed the anomaly detector's
+    ``steady_state_retrace`` rule with the recompiled function's name —
+    and, when the auto-profiler is armed (``FLUXMPI_TPU_PROFILE_DIR``),
+    trigger a bounded XPlane capture. An XLA ``RESOURCE_EXHAUSTED``
+    escaping the dispatch loop writes the ``fluxmpi_oom.<proc>.json``
+    forensics bundle (live-array census, per-device HBM stats, peak
+    watermark, watchdog dump sections) before re-raising. See
+    docs/observability.md, "Device plane".
+
     Run health: with the goodput tracker enabled (``init(goodput=True)``
     / ``FLUXMPI_TPU_GOODPUT=1``) the loop attributes wall time into the
     :mod:`~fluxmpi_tpu.telemetry.goodput` buckets and records live
@@ -292,20 +333,45 @@ def train_loop(
     from .. import comm as _comm
     from ..telemetry import get_registry
     from ..telemetry import anomaly as _anomaly
+    from ..telemetry import compileplane as _compileplane
     from ..telemetry import goodput as _goodput
     from .train import _DEFAULT_REGISTRY
 
-    # Run-health plane, resolved ONCE per run (the zero-cost-when-off
-    # contract: with both disabled the hot loop below branches on two
-    # local bools — no perf_counter reads, no registry lookups, no
-    # context managers). Enablement is env/init-driven, hence
-    # SPMD-consistent; halt decisions are made at flush boundaries every
-    # process reaches at the same updates count, from SPMD-consistent
-    # signals (see telemetry/anomaly.py on policies).
+    # Run-health + device planes, resolved ONCE per run (the
+    # zero-cost-when-off contract: with all disabled the hot loop below
+    # branches on three local bools — no perf_counter reads, no registry
+    # lookups, no context managers, no monitoring subscriptions).
+    # Enablement is env/init-driven, hence SPMD-consistent; halt
+    # decisions are made at flush boundaries every process reaches at
+    # the same updates count, from SPMD-consistent signals (see
+    # telemetry/anomaly.py on policies).
     gp = _goodput.get_goodput_tracker()
     gp_on = gp.enabled
     detector = _anomaly.get_anomaly_detector()
     det_on = detector is not None and detector.enabled
+    cp = _compileplane.get_compile_monitor()
+    cp_on = cp is not None and cp.enabled
+    if cp_on:
+        # Tag the hot step for retrace attribution: its jit-cache growth
+        # after the warmup boundary names it in the steady_state_retrace
+        # event. The first flush IS the warmup boundary (observe_flush
+        # marks it), so first-dispatch compiles never fire the rule.
+        # One run window per train_loop (the goodput reset_run
+        # discipline): without it a SECOND loop in the same process
+        # would inherit run 1's steady-state mark and report its own
+        # legitimate warmup compiles as retraces.
+        cp.track("train_loop.step", hot)
+        cp.reset_run()
+    if det_on:
+        # The anomaly-triggered auto-profiler budgets captures PER RUN
+        # (the documented contract): re-open it alongside the goodput
+        # and compile run windows. Detector-gated — triggers only come
+        # through the detector, so the off path reads nothing.
+        from ..utils.profiling import get_auto_profiler
+
+        auto_profiler = get_auto_profiler()
+        if auto_profiler is not None:
+            auto_profiler.reset()
     halt_rule: str | None = None
     if gp_on:
         # One tracker window per train_loop run: without the reset, a
@@ -588,12 +654,29 @@ def train_loop(
             stall_base = stall
             # goodput.* gauges ride the same flush line as train.*.
             gp.record(_live_registry() if record_metrics else None)
+        retraces: int | None = None
+        retraced: str | None = None
+        if cp_on:
+            # Device plane: sync compile.* metrics, poll tagged jit
+            # caches, cross-check the goodput compile bucket. The first
+            # flush marks the warmup boundary; compile events on any
+            # later flush are steady-state retraces handed to the
+            # detector with the recompiled function's name.
+            info = cp.observe_flush(
+                _live_registry() if record_metrics else None,
+                goodput_tracker=gp if gp_on else None,
+            )
+            if info["steady"] and info["events"]:
+                retraces = info["events"]
+                retraced = ",".join(info["functions"])
         if det_on:
             events = detector.observe(
                 loss=loss_v,
                 grad_norm=grad_v,
                 step_seconds=per_update,
                 fetch_seconds=fetch_per_update,
+                retraces=retraces,
+                retraced=retraced,
                 step=updates,
             )
             for ev in events:
@@ -605,7 +688,12 @@ def train_loop(
 
     done = False
     first_dispatch = True
-    while not done:
+    # The dispatch/drain region runs under OOM forensics: an XLA
+    # RESOURCE_EXHAUSTED escaping it writes the fluxmpi_oom.<proc>.json
+    # census bundle before re-raising (error path only — the happy path
+    # pays a zero-cost try frame).
+    try:
+      while not done:
         if epochs is not None and epochs_done >= epochs:
             break
         if steps is not None and updates >= steps:
@@ -722,16 +810,21 @@ def train_loop(
                 "pass a re-iterable loader for multi-epoch runs"
             )
 
-    if gp_on and window:
+      if gp_on and window:
         # Draining after a preemption is badput the preemption caused;
         # a normal end-of-run drain is the tail of productive compute.
         with gp.segment("preemption_drain" if preempted else "step"):
             while window:
                 jax.block_until_ready(window.popleft())
-    else:
+      else:
         while window:
             jax.block_until_ready(window.popleft())
-    flush()
+      flush()
+    except Exception as exc:
+        _maybe_oom_forensics(
+            exc, _live_registry() if record_metrics else None
+        )
+        raise
     if preempted:
         # Drained and flushed: bank the final boundary and exit cleanly.
         # The trace instant is the preemption event the schema validates.

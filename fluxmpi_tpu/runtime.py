@@ -227,6 +227,9 @@ def init(
     faults: Any = None,
     goodput: Any = None,
     anomaly: Any = None,
+    compileplane: Any = None,
+    memory: Any = None,
+    profile: Any = None,
 ) -> Mesh:
     """Bring up the fluxmpi_tpu runtime. Idempotent.
 
@@ -294,16 +297,40 @@ def init(
         :mod:`fluxmpi_tpu.telemetry.anomaly`). ``None`` defers to
         ``FLUXMPI_TPU_ANOMALY``. All the observability/robustness specs
         are applied on idempotent replays too.
+      compileplane: install the compile/retrace monitor — ``True``
+        subscribes to ``jax.monitoring`` compile events, emits
+        ``compile.*`` metrics at ``train_loop`` flush boundaries, and
+        arms the ``steady_state_retrace`` anomaly rule (see
+        :mod:`fluxmpi_tpu.telemetry.compileplane`); or pass a
+        :class:`~fluxmpi_tpu.telemetry.CompileMonitor`. ``None`` defers
+        to ``FLUXMPI_TPU_COMPILEPLANE``.
+      memory: enable the HBM plane — ``True`` turns on per-device
+        ``memory.*`` gauges + the peak watermark and folds the local
+        peak into :class:`~fluxmpi_tpu.telemetry.TrainingMonitor`'s
+        cross-host gather (see :mod:`fluxmpi_tpu.telemetry.memory`;
+        OOM forensics bundles are written regardless — they ride the
+        error path). ``None`` defers to ``FLUXMPI_TPU_MEMORY``.
+      profile: arm anomaly-triggered auto-profiling — a logdir path
+        captures one bounded XPlane window there on
+        ``step_time_regression`` / ``steady_state_retrace`` triggers
+        (and on ``SIGUSR2``), rate-limited to once per run; see
+        :func:`fluxmpi_tpu.utils.profiling.configure_auto_profiler`.
+        ``None`` defers to ``FLUXMPI_TPU_PROFILE_DIR`` (window/limit
+        from ``FLUXMPI_TPU_PROFILE_SECONDS`` /
+        ``FLUXMPI_TPU_PROFILE_LIMIT``).
 
     Returns:
       The global :class:`jax.sharding.Mesh`.
     """
     from .logging import fluxmpi_println  # local import: avoid cycle
     from .telemetry import anomaly as _anomaly
+    from .telemetry import compileplane as _compileplane
     from .telemetry import configure as _configure_telemetry
     from .telemetry import goodput as _goodput
+    from .telemetry import memory as _memory
     from .telemetry import tracing as _tracing
     from .telemetry import watchdog as _watchdog
+    from .utils import profiling as _profiling
     from . import faults as _faults_mod
 
     if _state.initialized:
@@ -314,6 +341,9 @@ def init(
         _faults_mod.configure(faults)
         _goodput.configure(goodput)
         _anomaly.configure(anomaly)
+        _compileplane.configure(compileplane)
+        _memory.configure(memory)
+        _profiling.configure_auto_profiler(profile)
         if verbose:
             fluxmpi_println("fluxmpi_tpu already initialized; skipping...")
         assert _state.mesh is not None
@@ -370,6 +400,9 @@ def init(
     _faults_mod.configure(faults)
     _goodput.configure(goodput)
     _anomaly.configure(anomaly)
+    _compileplane.configure(compileplane)
+    _memory.configure(memory)
+    _profiling.configure_auto_profiler(profile)
 
     if verbose:
         if total_workers() == 1:

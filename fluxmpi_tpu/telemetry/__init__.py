@@ -1,7 +1,8 @@
-"""Telemetry: metrics, tracing, flight recorder, watchdog, run health.
+"""Telemetry: metrics, tracing, flight recorder, watchdog, run health,
+and the device plane.
 
 The observability layer the reference never had (SURVEY.md §5: its only
-timing is ad-hoc wall-clock deltas in example scripts). Three planes:
+timing is ad-hoc wall-clock deltas in example scripts). Four planes:
 
 **Metrics plane** (PR 1) — aggregates over time:
 
@@ -45,6 +46,26 @@ progress, and is the run still sane:
   data-stall rules; warn/halt policies; triggers emit an ``anomaly.*``
   trace instant and a diagnostics bundle built from the watchdog's
   dump machinery.
+
+**Device plane** (PR 9) — what XLA and the HBM are actually doing,
+below every host-side number:
+
+- :mod:`~fluxmpi_tpu.telemetry.compileplane` —
+  :class:`CompileMonitor` subscribes to ``jax.monitoring`` compile
+  events (``compile.*`` metrics), attributes retraces to tagged jit
+  functions, and feeds the ``steady_state_retrace`` anomaly rule (a
+  compile after warmup = the silent perf killer), cross-checked
+  against the goodput compile bucket;
+- :mod:`~fluxmpi_tpu.telemetry.memory` — normalized per-device HBM
+  stats (``memory.*`` gauges + peak watermark, folded into the
+  monitor's cross-host gather), a :func:`jax.live_arrays` census, and
+  OOM forensics: ``train_loop`` writes a ``fluxmpi_oom.<proc>.json``
+  bundle on ``RESOURCE_EXHAUSTED`` before re-raising;
+- anomaly-triggered auto-profiling
+  (:mod:`fluxmpi_tpu.utils.profiling`) — ``step_time_regression`` /
+  ``steady_state_retrace`` triggers (and ``SIGUSR2``) capture one
+  bounded XPlane window into ``FLUXMPI_TPU_PROFILE_DIR``, rate-limited
+  once per run.
 
 Recording is always on for metrics and the flight recorder (updates are
 a few dict/deque ops); span recording and the watchdog are opt-in
@@ -119,6 +140,13 @@ from .anomaly import (  # noqa: F401
     get_anomaly_detector,
     set_anomaly_detector,
 )
+from . import compileplane  # noqa: F401
+from .compileplane import (  # noqa: F401
+    CompileMonitor,
+    get_compile_monitor,
+    set_compile_monitor,
+)
+from . import memory  # noqa: F401
 
 __all__ = [
     "Counter",
@@ -162,6 +190,9 @@ __all__ = [
     "AnomalyDetector",
     "get_anomaly_detector",
     "set_anomaly_detector",
+    "CompileMonitor",
+    "get_compile_monitor",
+    "set_compile_monitor",
     "configure",
     "shutdown",
 ]
@@ -227,7 +258,8 @@ def configure(spec: Any = None) -> MetricsRegistry:
 def shutdown() -> None:
     """Tear down the observability planes in failure-safe order: disarm
     the watchdog, export the trace ring (when a path was configured),
-    reset the run-health plane (goodput window + anomaly detector —
+    reset the run-health plane (goodput window + anomaly detector) and
+    the device plane (compile monitor, HBM watermark, auto-profiler —
     state left armed would leak into the next init cycle), then flush
     and detach every sink on the default registry (instruments survive —
     a re-configured registry keeps its cumulative counters)."""
@@ -245,6 +277,22 @@ def shutdown() -> None:
         pass
     try:
         anomaly.shutdown()
+    except Exception:
+        pass
+    try:
+        compileplane.shutdown()
+    except Exception:
+        pass
+    try:
+        memory.shutdown()
+    except Exception:
+        pass
+    try:
+        # Lazy import: profiling lives in utils (it needs jax); the
+        # telemetry package itself must stay importable without it.
+        from ..utils.profiling import shutdown_auto_profiler
+
+        shutdown_auto_profiler()
     except Exception:
         pass
     get_registry().close()

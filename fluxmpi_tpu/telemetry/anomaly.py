@@ -20,6 +20,12 @@ rule                        trigger
                             ``data_stall_factor`` × the interval's
                             *compute* remainder (step time − wait) — the
                             device is input-bound
+``steady_state_retrace``    the compile plane
+                            (:mod:`~fluxmpi_tpu.telemetry.compileplane`)
+                            observed XLA compile events after the warmup
+                            boundary — a shape or Python-identity change
+                            is silently recompiling the step; the event
+                            names the recompiled function
 ==========================  ================================================
 
 Each rule carries a **policy**: ``"warn"`` (record and continue),
@@ -41,7 +47,14 @@ On trigger the detector emits the full diagnostic surface:
   flight-recorder tail, open spans, a final registry flush) plus an
   ``anomaly`` section naming the rule/value/step — so the artifact a
   responder needs exists the moment the run went wrong, not after an
-  interactive session reproduces it.
+  interactive session reproduces it;
+- for the *performance* rules (``step_time_regression``,
+  ``steady_state_retrace``): a triggered profiler capture — when the
+  auto-profiler is armed (``FLUXMPI_TPU_PROFILE_DIR`` /
+  ``init(profile=...)``, see :mod:`fluxmpi_tpu.utils.profiling`), one
+  bounded XPlane window is captured so the regression's device-side
+  evidence is on disk before a human looks (rate-limited, once per run
+  by default).
 
 Zero-cost-when-off: no detector installed (the default) means
 ``train_loop`` reads one module attribute per run and never calls
@@ -79,6 +92,7 @@ RULES = (
     "loss_spike",
     "step_time_regression",
     "data_stall",
+    "steady_state_retrace",
 )
 
 POLICIES = ("warn", "halt", "off")
@@ -89,7 +103,14 @@ _DEFAULT_POLICIES = {
     "loss_spike": "warn",
     "step_time_regression": "warn",
     "data_stall": "warn",
+    # Per-host signal (each process compiles independently) — never a
+    # halt default, like the other statistical rules.
+    "steady_state_retrace": "warn",
 }
+
+# Rules whose trigger is *performance* evidence an XPlane capture can
+# explain — they invoke the armed auto-profiler on emission.
+_PROFILE_TRIGGER_RULES = ("step_time_regression", "steady_state_retrace")
 
 
 def _finite(x: float) -> bool:
@@ -203,6 +224,8 @@ class AnomalyDetector:
         grad_norm: float | None = None,
         step_seconds: float | None = None,
         fetch_seconds: float | None = None,
+        retraces: int | None = None,
+        retraced: str | None = None,
         step: int | None = None,
     ) -> list[dict[str, Any]]:
         """Evaluate every armed rule against one flush interval's
@@ -213,7 +236,12 @@ class AnomalyDetector:
         ``"halt"``. All inputs optional — a rule whose input is absent
         stays quiet (``fetch_seconds`` is the per-update loader wait,
         which the loop derives from the goodput plane's ``data_stall``
-        bucket, so the data-stall rule needs goodput enabled there)."""
+        bucket, so the data-stall rule needs goodput enabled there;
+        ``retraces`` is the interval's steady-state compile-event count
+        from the compile plane's
+        :meth:`~fluxmpi_tpu.telemetry.compileplane.CompileMonitor.observe_flush`,
+        with ``retraced`` naming the recompiled function(s) — the
+        ``steady_state_retrace`` event carries it as ``function``)."""
         if not self.enabled:
             return []
         events: list[dict[str, Any]] = []
@@ -296,6 +324,17 @@ class AnomalyDetector:
                 if ev:
                     events.append(ev)
 
+        if retraces is not None and retraces > 0:
+            # No detector-side warmup: the compile plane already owns
+            # the warmup boundary (its first observe_flush) and only
+            # reports steady-state events here.
+            from .compileplane import UNTRACKED
+
+            ev = self._event("steady_state_retrace", float(retraces), step)
+            if ev:
+                ev["function"] = retraced or UNTRACKED
+                events.append(ev)
+
         for ev in events:
             self._emit(ev)
         return events
@@ -309,6 +348,9 @@ class AnomalyDetector:
             reg.counter("anomaly.triggered", rule=ev["rule"]).inc()
         from . import tracing as _tracing
 
+        extra: dict[str, Any] = {}
+        if "function" in ev:
+            extra["function"] = ev["function"]
         _tracing.instant(
             "anomaly." + ev["rule"],
             rule=ev["rule"],
@@ -316,10 +358,13 @@ class AnomalyDetector:
             value=ev["value"],
             value_repr=ev["value_repr"],
             action=ev["action"],
+            **extra,
         )
         warnings.warn(
             f"anomaly detected: {ev['rule']} (value {ev['value_repr']} at "
-            f"step {ev['step']}) — policy {ev['action']!r}"
+            f"step {ev['step']})"
+            + (f" in {ev['function']}" if "function" in ev else "")
+            + f" — policy {ev['action']!r}"
             + (
                 f"; diagnostics bundle at {self.dump_path()}"
                 if self.dump
@@ -335,6 +380,16 @@ class AnomalyDetector:
                     f"anomaly diagnostics bundle write failed: {exc!r}",
                     stacklevel=4,
                 )
+        if ev["rule"] in _PROFILE_TRIGGER_RULES:
+            # Performance anomaly: capture the device-side evidence while
+            # the regression is still happening. No-op (one None check)
+            # when the auto-profiler is unarmed; rate-limited when armed.
+            try:
+                from ..utils.profiling import maybe_auto_capture
+
+                maybe_auto_capture(f"anomaly:{ev['rule']}")
+            except Exception:  # diagnostics must never kill the run
+                pass
 
     def dump_path(self) -> str:
         return os.path.join(

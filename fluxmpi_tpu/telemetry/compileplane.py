@@ -1,0 +1,378 @@
+"""Compile/retrace telemetry: the device plane's silent perf killer.
+
+A jitted step that quietly retraces every step — a shape that drifts, a
+Python object whose identity keys the jit cache, a non-weak-type scalar
+— burns most of its wall clock in XLA compilation while every host-side
+metric still says "training". The reference has no visibility below the
+host at all (SURVEY.md §5), and this repo's first three planes (metrics,
+traces, run health) watch the host side only; PR 4's
+``step._cache_size() == 1`` tests guard retraces point-wise in CI but
+see nothing in a live run.
+
+:class:`CompileMonitor` closes that gap from two directions:
+
+- **ground truth from XLA** — it subscribes to :mod:`jax.monitoring`
+  compile duration events (``/jax/core/compile/*``) and accumulates
+  every trace/lower/compile the process performs into closed-namespace
+  ``compile.*`` metrics (event count, cumulative seconds per phase);
+- **attribution from the jit cache** — callers :meth:`track` their
+  compiled functions (``train_loop`` tags its hot step automatically);
+  at every :meth:`observe_flush` the monitor polls each tracked
+  function's ``_cache_size()`` and attributes the interval's compile
+  seconds to the functions whose caches grew.
+
+The **steady-state retrace** signal combines both: the first
+``observe_flush`` marks the warmup boundary (first-dispatch compiles are
+legitimate); ANY compile event after it is a retrace, reported with the
+recompiled function's name — ``train_loop`` feeds it to the
+:class:`~fluxmpi_tpu.telemetry.anomaly.AnomalyDetector`'s
+``steady_state_retrace`` rule, which fires an ``anomaly.*`` instant and
+(when armed) an automatic profiler capture
+(:mod:`fluxmpi_tpu.utils.profiling`).
+
+The monitor also **cross-checks the goodput plane**: the tracker's
+``compile`` bucket only sees the first dispatch, so compile seconds XLA
+reports beyond that bucket are compile time hiding inside "productive"
+step wall time — exactly what a steady-state retrace looks like from the
+host. The gap lands in the ``compile.unattributed_seconds`` gauge.
+
+Zero-cost-when-off (the PR 4 contract): no monitor installed (the
+default) means **no** ``jax.monitoring`` subscription exists and
+``train_loop`` reads one module attribute per run. The listeners are
+registered once, on first install, and dispatch through the module
+singleton; uninstalling detaches the singleton (jax.monitoring has no
+per-listener deregistration), leaving a None-check per compile event —
+and compiling is already a millisecond-scale operation.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable
+
+from .registry import MetricsRegistry, get_registry
+
+__all__ = [
+    "CompileMonitor",
+    "get_compile_monitor",
+    "set_compile_monitor",
+    "configure",
+    "shutdown",
+    "COMPILE_PHASES",
+    "UNTRACKED",
+]
+
+_ENV_VAR = "FLUXMPI_TPU_COMPILEPLANE"
+
+# jax.monitoring duration event -> our phase label. backend_compile is
+# the authoritative "an executable was built" signal; trace/lower are
+# the host-side costs that precede it (and fire on their own for
+# abstract lowerings like cost_analysis).
+COMPILE_PHASES: dict[str, str] = {
+    "/jax/core/compile/jaxpr_trace_duration": "trace",
+    "/jax/core/compile/jaxpr_to_mlir_module_duration": "lower",
+    "/jax/core/compile/backend_compile_duration": "compile",
+}
+
+# The function label when compile events happened but no tracked
+# function's cache grew (an untagged jit, or growth not yet visible).
+UNTRACKED = "<untracked>"
+
+
+class CompileMonitor:
+    """Compile-event accounting + per-tagged-function retrace detection.
+
+    Args:
+      registry: registry the ``compile.*`` metrics land in at
+        :meth:`observe_flush` (default: the process-global one, resolved
+        at flush time so a swapped registry is honored).
+
+    Thread discipline: jax.monitoring listeners fire on whatever thread
+    compiles, so the event totals live behind a lock; everything else
+    (track/observe_flush) is driver-thread only, like the goodput
+    tracker.
+    """
+
+    def __init__(self, *, registry: MetricsRegistry | None = None):
+        self.enabled = True
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._events = 0  # backend_compile completions
+        self._seconds: dict[str, float] = {p: 0.0 for p in ("trace", "lower", "compile")}
+        self._tracked: dict[str, Any] = {}
+        self._cache_sizes: dict[str, int] = {}
+        self._steady = False
+        # observe_flush delta baselines.
+        self._flushed_events = 0
+        self._flushed_seconds: dict[str, float] = dict(self._seconds)
+        # Compile seconds accumulated before the current run window —
+        # the goodput cross-check compares per-run against the
+        # tracker's per-run compile bucket.
+        self._run_base_seconds = 0.0
+        self.retraces: list[dict[str, Any]] = []
+
+    def reset_run(self) -> None:
+        """Open a new run window (``train_loop`` calls this at start,
+        next to the goodput tracker's ``reset_run``): warmup re-opens —
+        a NEW loop's first-dispatch compiles are legitimate, not
+        steady-state retraces of the previous run — the per-run retrace
+        log clears, and the goodput cross-check re-bases on the current
+        totals (the tracker's compile bucket is per-run too). The
+        cumulative event/seconds totals and flush baselines survive:
+        the ``compile.*`` counters stay monotonic across runs."""
+        self._steady = False
+        self.retraces = []
+        with self._lock:
+            self._run_base_seconds = sum(self._seconds.values())
+
+    # -- listener side (any thread) ------------------------------------
+
+    def _note_duration(self, event: str, seconds: float) -> None:
+        phase = COMPILE_PHASES.get(event)
+        if phase is None or not self.enabled:
+            return
+        with self._lock:
+            self._seconds[phase] += float(seconds)
+            if phase == "compile":
+                self._events += 1
+
+    # -- driver side ---------------------------------------------------
+
+    @staticmethod
+    def _cache_size(fn: Any) -> int:
+        """A jit function's cache entry count; -1 when the callable does
+        not expose one (attribution degrades to ``<untracked>``)."""
+        size = getattr(fn, "_cache_size", None)
+        if callable(size):
+            try:
+                return int(size())
+            except Exception:
+                return -1
+        return -1
+
+    def track(self, name: str, fn: Any) -> None:
+        """Register a compiled callable for retrace attribution under
+        ``name`` (its current cache size becomes the baseline)."""
+        self._tracked[name] = fn
+        self._cache_sizes[name] = self._cache_size(fn)
+
+    def mark_steady(self) -> None:
+        """Declare warmup over: any compile event from here on is a
+        steady-state retrace. ``observe_flush`` does this implicitly
+        after its first call (the train_loop warmup boundary)."""
+        self._steady = True
+
+    @property
+    def steady(self) -> bool:
+        return self._steady
+
+    @property
+    def events(self) -> int:
+        """Total backend-compile completions observed."""
+        with self._lock:
+            return self._events
+
+    def compile_seconds(self, phase: str | None = None) -> float:
+        """Cumulative observed compile seconds — one phase (``trace`` /
+        ``lower`` / ``compile``) or, with None, all phases summed."""
+        with self._lock:
+            if phase is not None:
+                return self._seconds.get(phase, 0.0)
+            return sum(self._seconds.values())
+
+    def _growers(self) -> dict[str, int]:
+        """Tracked functions whose jit caches grew since the last poll,
+        mapped to HOW MANY entries they grew by (the per-function
+        retrace count for the interval)."""
+        grown: dict[str, int] = {}
+        for name, fn in self._tracked.items():
+            size = self._cache_size(fn)
+            base = self._cache_sizes.get(name, -1)
+            if size > base >= 0:
+                grown[name] = size - base
+            self._cache_sizes[name] = size
+        return grown
+
+    def observe_flush(
+        self,
+        registry: MetricsRegistry | None = None,
+        *,
+        goodput_tracker: Any = None,
+    ) -> dict[str, Any]:
+        """One flush boundary's compile accounting. Computes the deltas
+        since the previous call, attributes them to the tracked
+        functions whose jit caches grew, writes the ``compile.*``
+        metrics, and returns::
+
+            {"steady": <was steady-state BEFORE this call>,
+             "events": <backend compiles this interval>,
+             "seconds": <total compile-phase seconds this interval>,
+             "functions": [<grown tracked fn names, or "<untracked>">]}
+
+        The FIRST call marks the warmup boundary (``steady`` False in
+        its return, True from then on) — first-dispatch compiles are
+        legitimate; everything later is a retrace ``train_loop`` hands
+        to the anomaly detector. With ``goodput_tracker`` given (and
+        carrying a ``compile`` bucket), the gauge
+        ``compile.unattributed_seconds`` records cumulative compile
+        seconds XLA reported beyond what the tracker booked as compile —
+        compile time hiding inside productive step wall time.
+        """
+        with self._lock:
+            events = self._events
+            seconds = dict(self._seconds)
+        delta_events = events - self._flushed_events
+        delta_seconds = {
+            p: seconds[p] - self._flushed_seconds.get(p, 0.0) for p in seconds
+        }
+        self._flushed_events = events
+        self._flushed_seconds = seconds
+        delta_total = sum(delta_seconds.values())
+        growers = self._growers()
+        functions = list(growers)
+        if delta_events and not functions:
+            functions = [UNTRACKED]
+        was_steady = self._steady
+        self._steady = True
+        reg = registry
+        if reg is None:
+            reg = self._registry if self._registry is not None else get_registry()
+        if getattr(reg, "enabled", True):
+            if delta_events:
+                reg.counter("compile.events").inc(delta_events)
+            for phase, dur in delta_seconds.items():
+                if dur > 0:
+                    reg.counter("compile.seconds", phase=phase).inc(dur)
+            if delta_events:
+                share = delta_total / len(functions)
+                for name in functions:
+                    reg.counter(
+                        "compile.function_seconds", function=name
+                    ).inc(share)
+                    if was_steady:
+                        # Count every retrace, not one per flush: a
+                        # storm of 50 recompiles in one interval must
+                        # read as 50 (per-function count = the jit-cache
+                        # growth; untracked growth = the event delta).
+                        reg.counter("compile.retraces", function=name).inc(
+                            growers.get(name, delta_events)
+                        )
+            if goodput_tracker is not None and getattr(
+                goodput_tracker, "enabled", False
+            ):
+                # Per-run comparison: the tracker's compile bucket was
+                # reset at run start, so subtract only the compile
+                # seconds observed SINCE then — pre-run compiles (model
+                # init, a previous loop) are not hidden step time.
+                booked = goodput_tracker.bucket_seconds("compile")
+                run_seconds = sum(seconds.values()) - self._run_base_seconds
+                reg.gauge("compile.unattributed_seconds").set(
+                    max(0.0, run_seconds - booked)
+                )
+        info = {
+            "steady": was_steady,
+            "events": delta_events,
+            "seconds": delta_total,
+            "functions": functions if delta_events else [],
+        }
+        if was_steady and delta_events:
+            self.retraces.append(info)
+        return info
+
+
+# ---------------------------------------------------------------------------
+# Module singleton + the one-time jax.monitoring subscription. The
+# listener is registered on FIRST install (never at import, never while
+# the plane is off — the no-subscribe half of the zero-cost contract)
+# and dispatches through `_active`, so uninstalling detaches the monitor
+# even though jax.monitoring keeps the callback.
+# ---------------------------------------------------------------------------
+
+_active: CompileMonitor | None = None
+_active_lock = threading.Lock()
+_listener_registered = False
+
+
+def _on_duration(event: str, duration: float, **kwargs: Any) -> None:
+    mon = _active
+    if mon is not None:
+        mon._note_duration(event, duration)
+
+
+def _ensure_listener() -> None:
+    # Caller holds _active_lock: an unsynchronized check-then-act here
+    # could register the listener twice under concurrent installs, and
+    # jax.monitoring has no deregistration — every compile would count
+    # double for the life of the process.
+    global _listener_registered
+    if _listener_registered:
+        return
+    import jax.monitoring
+
+    jax.monitoring.register_event_duration_secs_listener(_on_duration)
+    _listener_registered = True
+
+
+def get_compile_monitor() -> CompileMonitor | None:
+    """The installed compile monitor, if any (None = plane off)."""
+    return _active
+
+
+def set_compile_monitor(
+    monitor: CompileMonitor | None,
+) -> CompileMonitor | None:
+    """Install (or, with None, remove) the process compile monitor;
+    returns the previous one. Installing subscribes the one-time
+    jax.monitoring listener."""
+    global _active
+    with _active_lock:
+        prev, _active = _active, monitor
+        if monitor is not None:
+            _ensure_listener()
+    return prev
+
+
+def configure(spec: Any = None) -> CompileMonitor | None:
+    """Wire the compile plane from a one-value spec (mirror of
+    :func:`fluxmpi_tpu.telemetry.configure`):
+
+    - ``None`` — read ``FLUXMPI_TPU_COMPILEPLANE`` (same forms; no-op
+      when unset/empty);
+    - ``False`` / ``"0"`` — uninstall;
+    - ``True`` / ``"1"`` — install a default :class:`CompileMonitor`;
+    - a :class:`CompileMonitor` — install it.
+
+    Called by ``fluxmpi_tpu.init(compileplane=...)``; idempotent — an
+    installed monitor keeps its totals/baselines on a replay.
+    """
+    if spec is None:
+        spec = os.environ.get(_ENV_VAR)
+        if spec is None or spec == "":
+            return _active
+    if isinstance(spec, CompileMonitor):
+        spec.enabled = True
+        set_compile_monitor(spec)
+        return spec
+    if spec is False or spec == "0":
+        set_compile_monitor(None)
+        return None
+    if spec is True or spec == "1":
+        if _active is not None:
+            _active.enabled = True
+            return _active
+        mon = CompileMonitor()
+        set_compile_monitor(mon)
+        return mon
+    raise ValueError(
+        f"compileplane spec must be a bool, '0'/'1', or a CompileMonitor; "
+        f"got {spec!r}"
+    )
+
+
+def shutdown() -> None:
+    """Uninstall the monitor — compile totals and the steady-state mark
+    must never leak into the next init cycle (the fault-plane leak
+    rule). The jax.monitoring callback stays registered (no
+    deregistration API) but dispatches to nothing."""
+    set_compile_monitor(None)

@@ -1,0 +1,284 @@
+"""HBM accounting and OOM forensics: what is resident when it matters.
+
+An XLA OOM is a bare ``RESOURCE_EXHAUSTED`` string: it names the failed
+allocation, not what was already resident — and on a preemptible fleet
+the process is gone before anyone can attach a debugger. This module
+gives the device-memory story three surfaces:
+
+- **normalized per-device stats** — :func:`device_memory_stats` is the
+  ONE copy of the ``memory_stats()``-key normalization
+  (``bytes_in_use`` / ``peak_bytes_in_use`` / ``bytes_limit``; backends
+  without stats yield ``{}``), shared by
+  :class:`~fluxmpi_tpu.telemetry.monitor.TrainingMonitor` and everything
+  here;
+- **live gauges + peak watermark** — :func:`record_hbm` emits
+  closed-namespace ``memory.*`` gauges per local device and maintains a
+  process-lifetime high-water mark (``memory.peak_watermark_bytes``);
+  when the plane is enabled, ``TrainingMonitor.collect`` folds the local
+  peak into its existing single ``host_allgather`` so the fleet-wide
+  min/max/mean HBM pressure costs zero extra collectives;
+- **the census** — :func:`census` walks :func:`jax.live_arrays` and
+  returns the top-N buffers by ``nbytes`` with shape/dtype/sharding —
+  the "what was resident" answer;
+- **OOM forensics** — :func:`write_oom_bundle` assembles a
+  ``fluxmpi_oom.<process>.json`` bundle (the census, per-device stats,
+  the watermark, and the watchdog's full dump sections — thread stacks,
+  flight-recorder tail, open spans, final registry flush) validated by
+  the same schema machinery as the anomaly bundle.
+  :func:`~fluxmpi_tpu.parallel.train_loop` catches
+  ``RESOURCE_EXHAUSTED`` dispatch errors, writes the bundle, and
+  re-raises — the evidence survives the process.
+
+Zero-cost-when-off: the plane's periodic surfaces (gauges, the monitor
+fold) are gated on :func:`enabled` (``init(memory=True)`` /
+``FLUXMPI_TPU_MEMORY=1`` — env/init-driven, hence SPMD-consistent for
+the allgather width); census walks happen only on demand or on the OOM
+error path, never in steady state.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any
+
+from .registry import MetricsRegistry, get_registry
+from .registry import process_index_or_zero as _process_index
+
+__all__ = [
+    "device_memory_stats",
+    "record_hbm",
+    "peak_watermark_bytes",
+    "census",
+    "is_oom_error",
+    "oom_dump_path",
+    "write_oom_bundle",
+    "enabled",
+    "configure",
+    "shutdown",
+    "STATS_KEYS",
+]
+
+_ENV_VAR = "FLUXMPI_TPU_MEMORY"
+_ENV_OOM_DIR = "FLUXMPI_TPU_OOM_DIR"
+
+# The memory_stats() keys every consumer reads, in one place. Backends
+# report more (num_allocs, largest_alloc_size, pool sizes); these three
+# are the cross-backend HBM story: current residency, the allocator's
+# high-water mark, and the capacity it is allowed to fill.
+STATS_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+
+_enabled = False
+_watermark = 0.0
+_watermark_lock = threading.Lock()
+
+
+def enabled() -> bool:
+    """Whether the periodic HBM surfaces (gauges + monitor fold) are on."""
+    return _enabled
+
+
+def device_memory_stats(device: Any) -> dict[str, float]:
+    """``device.memory_stats()`` normalized to the :data:`STATS_KEYS`
+    subset as floats; ``{}`` for backends without stats (CPU) or devices
+    that raise. The single copy of this normalization — TrainingMonitor
+    and the OOM bundle both read through it."""
+    try:
+        stats = device.memory_stats() or {}
+    except Exception:  # backends without memory stats
+        return {}
+    return {
+        key: float(stats[key]) for key in STATS_KEYS if key in stats
+    }
+
+
+def record_hbm(registry: MetricsRegistry | None = None) -> dict[str, Any]:
+    """Snapshot every local device's HBM stats into ``memory.*`` gauges
+    (labeled ``device=<local index>``), advance the process-lifetime
+    peak watermark, and return the snapshot::
+
+        {"local_peak_bytes": <max peak over local devices, 0.0 if unknown>,
+         "watermark_bytes": <process-lifetime max of the same>,
+         "devices": {"0": {<normalized stats>}, ...}}
+
+    Works regardless of :func:`enabled` (callers gate; the OOM path
+    wants the snapshot even when the periodic plane is off). Gauges are
+    skipped on a recording-disabled registry."""
+    global _watermark
+    import jax
+
+    reg = registry if registry is not None else get_registry()
+    emit = getattr(reg, "enabled", True)
+    devices: dict[str, dict[str, float]] = {}
+    local_peak = 0.0
+    for i, d in enumerate(jax.local_devices()):
+        stats = device_memory_stats(d)
+        devices[str(i)] = stats
+        if emit:
+            # Already normalized to STATS_KEYS, so every emitted name is
+            # a schema-known member of the closed memory.* namespace.
+            for key, val in stats.items():
+                reg.gauge(f"memory.{key}", device=str(i)).set(val)
+        peak = stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0.0))
+        local_peak = max(local_peak, peak)
+    with _watermark_lock:
+        _watermark = max(_watermark, local_peak)
+        watermark = _watermark
+    if emit:
+        reg.gauge("memory.peak_watermark_bytes").set(watermark)
+    return {
+        "local_peak_bytes": local_peak,
+        "watermark_bytes": watermark,
+        "devices": devices,
+    }
+
+
+def peak_watermark_bytes() -> float:
+    """Process-lifetime HBM high-water mark observed by :func:`record_hbm`
+    (0.0 before the first snapshot / on stat-less backends)."""
+    return _watermark
+
+
+def census(top_n: int = 10) -> dict[str, Any]:
+    """Walk :func:`jax.live_arrays` and summarize residency: total count
+    and bytes, plus the top ``top_n`` buffers by ``nbytes`` with shape,
+    dtype, and sharding spelled out. This is a full-heap walk — call it
+    on demand (OOM forensics, an interactive session), never per step."""
+    import jax
+
+    entries: list[dict[str, Any]] = []
+    count = 0
+    total = 0
+    for arr in jax.live_arrays():
+        count += 1
+        try:
+            nbytes = int(arr.nbytes)
+            shape = [int(d) for d in arr.shape]
+            dtype = str(arr.dtype)
+            sharding = str(getattr(arr, "sharding", None))
+        except Exception:
+            # A buffer deleted between enumeration and inspection — the
+            # census must describe the heap, not crash on its churn.
+            continue
+        total += nbytes
+        entries.append(
+            {
+                "nbytes": nbytes,
+                "shape": shape,
+                "dtype": dtype,
+                "sharding": sharding,
+            }
+        )
+    entries.sort(key=lambda e: e["nbytes"], reverse=True)
+    return {
+        "count": count,
+        "total_bytes": total,
+        "top_n": int(top_n),
+        "arrays": entries[: int(top_n)],
+    }
+
+
+# ---------------------------------------------------------------------------
+# OOM forensics
+# ---------------------------------------------------------------------------
+
+
+def is_oom_error(exc: BaseException) -> bool:
+    """Whether an exception is an XLA device-memory exhaustion (the
+    ``RESOURCE_EXHAUSTED`` family — jaxlib raises ``XlaRuntimeError``
+    with that status string; "out of memory" covers allocator messages
+    that drop the status prefix)."""
+    msg = str(exc)
+    return "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower()
+
+
+def oom_dump_path(dump_dir: str | None = None) -> str:
+    """Where the OOM bundle lands: ``fluxmpi_oom.<process>.json`` in
+    ``dump_dir`` (default ``FLUXMPI_TPU_OOM_DIR`` or ``.``) — the
+    stable-per-process-filename convention of the watchdog/anomaly
+    bundles."""
+    if dump_dir is None:
+        dump_dir = os.environ.get(_ENV_OOM_DIR, ".")
+    return os.path.join(dump_dir, f"fluxmpi_oom.{_process_index()}.json")
+
+
+def write_oom_bundle(
+    exc: BaseException,
+    *,
+    dump_dir: str | None = None,
+    registry: MetricsRegistry | None = None,
+    top_n: int = 15,
+) -> str:
+    """Write the OOM forensics bundle for ``exc`` and return its path.
+
+    The bundle IS a ``watchdog_dump``-kind record (thread stacks,
+    flight-recorder tail, open spans, final registry flush — the anomaly
+    bundle's exact machinery) with an ``oom`` section: the error string,
+    the live-array census, every local device's normalized stats, and
+    the process-lifetime peak watermark. ``validate_watchdog_dump``
+    (hence ``scripts/check_metrics_schema.py``) validates it."""
+    from .watchdog import Watchdog, get_watchdog
+
+    wd = get_watchdog()
+    if wd is None:
+        # An unarmed builder: build_dump never starts threads or
+        # installs signals — it only assembles the record.
+        wd = Watchdog(deadline=1.0, registry=registry)
+    record = wd.build_dump("oom")
+    snapshot = record_hbm(registry)
+    record["oom"] = {
+        "error": str(exc),
+        "error_type": type(exc).__name__,
+        "census": census(top_n),
+        "devices": snapshot["devices"],
+        "peak_watermark_bytes": snapshot["watermark_bytes"],
+    }
+    path = oom_dump_path(dump_dir)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(record, f, indent=1)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Plane wiring (init kwarg / env var)
+# ---------------------------------------------------------------------------
+
+
+def configure(spec: Any = None) -> bool:
+    """Wire the periodic HBM plane from a one-value spec (mirror of
+    :func:`fluxmpi_tpu.telemetry.configure`):
+
+    - ``None`` — read ``FLUXMPI_TPU_MEMORY`` (no-op when unset/empty);
+    - ``False`` / ``"0"`` — disable;
+    - ``True`` / ``"1"`` — enable.
+
+    Returns the resulting enabled state. Called by
+    ``fluxmpi_tpu.init(memory=...)``; idempotent. Enablement is
+    env/init-driven on every process, so the TrainingMonitor allgather
+    payload width it controls stays SPMD-consistent."""
+    global _enabled
+    if spec is None:
+        spec = os.environ.get(_ENV_VAR)
+        if spec is None or spec == "":
+            return _enabled
+    if spec is False or spec == "0":
+        _enabled = False
+        return _enabled
+    if spec is True or spec == "1":
+        _enabled = True
+        return _enabled
+    raise ValueError(
+        f"memory plane spec must be a bool or '0'/'1'; got {spec!r}"
+    )
+
+
+def shutdown() -> None:
+    """Disable the plane and drop the watermark — a high-water mark left
+    over from a previous run would misattribute the next run's OOM (the
+    fault-plane leak rule)."""
+    global _enabled, _watermark
+    _enabled = False
+    with _watermark_lock:
+        _watermark = 0.0

@@ -98,19 +98,33 @@ class TrainingMonitor:
 
     # -- collection ----------------------------------------------------
 
-    def _collect_memory(self) -> None:
-        import jax
+    def _collect_memory(self) -> float | None:
+        """Device + host memory gauges for this collect. Returns the
+        local peak-HBM watermark when the device memory plane is on
+        (``init(memory=True)`` — what :meth:`_aggregate_step_times`
+        folds into its host gather), else None."""
+        from . import memory as _memory
 
-        for i, d in enumerate(jax.local_devices()):
-            try:
-                stats = d.memory_stats() or {}
-            except Exception:  # backends without memory stats
-                stats = {}
-            for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
-                if key in stats:
-                    self.registry.gauge(
-                        f"device.memory.{key}", device=str(i)
-                    ).set(float(stats[key]))
+        local_peak: float | None = None
+        if _memory.enabled():
+            # One device walk: the memory plane's snapshot (closed
+            # memory.* gauges + process watermark) also feeds the legacy
+            # device.memory.* series below.
+            snap = _memory.record_hbm(self.registry)
+            local_peak = snap["local_peak_bytes"]
+            device_stats = snap["devices"].items()
+        else:
+            import jax
+
+            device_stats = (
+                (str(i), _memory.device_memory_stats(d))
+                for i, d in enumerate(jax.local_devices())
+            )
+        for dev, stats in device_stats:
+            for key, val in stats.items():
+                self.registry.gauge(
+                    f"device.memory.{key}", device=dev
+                ).set(val)
         # CPU (and some backends) report no per-device stats — the host
         # peak RSS keeps a memory signal in every stream regardless.
         try:
@@ -125,16 +139,20 @@ class TrainingMonitor:
             )
         except Exception:  # pragma: no cover - non-POSIX
             pass
+        return local_peak
 
-    def _aggregate_step_times(self) -> dict[str, float]:
+    def _aggregate_step_times(
+        self, local_hbm_peak: float | None = None
+    ) -> dict[str, float]:
         local_mean = sum(self._window) / len(self._window)
         import jax
 
-        # The run-health plane rides the SAME gather: when the goodput
-        # tracker is enabled (env/init-driven, hence SPMD-consistent —
-        # every process sends the same vector width), each host's
-        # goodput fraction travels next to its step time, and the
-        # cross-host min/max/mean cost zero extra collectives.
+        # The run-health AND device planes ride the SAME gather: when
+        # the goodput tracker / memory plane is enabled (env/init-
+        # driven, hence SPMD-consistent — every process sends the same
+        # vector width), each host's goodput fraction and peak-HBM
+        # watermark travel next to its step time, and the cross-host
+        # min/max/mean cost zero extra collectives.
         from . import goodput as _goodput
 
         gp = _goodput.get_goodput_tracker()
@@ -151,7 +169,7 @@ class TrainingMonitor:
             )
         nproc = jax.process_count()
         if self.cross_host and nproc > 1:  # pragma: no cover - multihost only
-            # ONE gather of the (1- or 2-wide) vector, statistics
+            # ONE gather of the (1- to 3-wide) vector, statistics
             # locally — per-statistic host_allreduce calls would
             # multiply the blocking collective cost paid every interval.
             from ..comm import host_allgather
@@ -159,22 +177,36 @@ class TrainingMonitor:
             payload = [local_mean]
             if local_goodput is not None:
                 payload.append(local_goodput)
-            gathered = host_allgather(np.float32(payload))
-            means = np.asarray(gathered).reshape(nproc, -1)[:, 0]
+            if local_hbm_peak is not None:
+                payload.append(local_hbm_peak)
+            gathered = np.asarray(host_allgather(np.float32(payload)))
+            cols = gathered.reshape(nproc, -1)
+            means = cols[:, 0]
             mn = float(means.min())
             mx = float(means.max())
             mean = float(means.mean())
+            col = 1
             if local_goodput is not None:
-                fracs = np.asarray(gathered).reshape(nproc, -1)[:, 1]
+                fracs = cols[:, col]
+                col += 1
                 gp_mn, gp_mx, gp_mean = (
                     float(fracs.min()),
                     float(fracs.max()),
                     float(fracs.mean()),
                 )
+            if local_hbm_peak is not None:
+                peaks = cols[:, col]
+                hbm_mn, hbm_mx, hbm_mean = (
+                    float(peaks.min()),
+                    float(peaks.max()),
+                    float(peaks.mean()),
+                )
         else:
             mn = mx = mean = local_mean
             if local_goodput is not None:
                 gp_mn = gp_mx = gp_mean = local_goodput
+            if local_hbm_peak is not None:
+                hbm_mn = hbm_mx = hbm_mean = local_hbm_peak
         straggler = mean > 0 and mx > self.straggler_threshold * mean
         reg = self.registry
         reg.gauge("monitor.step_seconds_local_mean").set(local_mean)
@@ -198,6 +230,15 @@ class TrainingMonitor:
                 goodput_fraction_max=gp_mx,
                 goodput_fraction_mean=gp_mean,
             )
+        if local_hbm_peak is not None:
+            reg.gauge("monitor.hbm_peak_bytes_min").set(hbm_mn)
+            reg.gauge("monitor.hbm_peak_bytes_max").set(hbm_mx)
+            reg.gauge("monitor.hbm_peak_bytes_mean").set(hbm_mean)
+            summary.update(
+                hbm_peak_bytes_min=hbm_mn,
+                hbm_peak_bytes_max=hbm_mx,
+                hbm_peak_bytes_mean=hbm_mean,
+            )
         return summary
 
     def collect(self) -> dict[str, Any]:
@@ -205,9 +246,9 @@ class TrainingMonitor:
         stamp the heartbeat, and flush the registry (one JSONL line on a
         file-sinked registry). Returns a plain-python summary."""
         summary: dict[str, Any] = {}
-        self._collect_memory()
+        local_hbm_peak = self._collect_memory()
         if self._window:
-            summary = self._aggregate_step_times()
+            summary = self._aggregate_step_times(local_hbm_peak)
             self._window = []
         self._since_collect = 0
         # Heartbeat: this host is alive and flushing. The *absence* of

@@ -95,6 +95,23 @@ KNOWN_METRIC_NAMES = frozenset(
         "goodput.mfu",
         "goodput.mfu_productive",
         "anomaly.triggered",
+        # Device plane (PR 9): XLA compile/retrace accounting
+        # (cumulative counters; seconds labeled {phase=trace|lower|
+        # compile}, attribution labeled {function=...}) and per-device
+        # HBM gauges ({device=<local index>}) with the process-lifetime
+        # peak watermark.
+        "compile.events",
+        "compile.seconds",
+        "compile.function_seconds",
+        "compile.retraces",
+        "compile.unattributed_seconds",
+        "memory.bytes_in_use",
+        "memory.peak_bytes_in_use",
+        "memory.bytes_limit",
+        "memory.peak_watermark_bytes",
+        "monitor.hbm_peak_bytes_min",
+        "monitor.hbm_peak_bytes_max",
+        "monitor.hbm_peak_bytes_mean",
         "monitor.heartbeat",
         "monitor.heartbeat_unix",
         "monitor.heartbeat_age_seconds",
@@ -110,7 +127,14 @@ KNOWN_METRIC_NAMES = frozenset(
     }
 )
 
-_CLOSED_NAMESPACES = ("fault.", "checkpoint.", "goodput.", "anomaly.")
+_CLOSED_NAMESPACES = (
+    "fault.",
+    "checkpoint.",
+    "goodput.",
+    "anomaly.",
+    "compile.",
+    "memory.",
+)
 
 # The preemption trace event train_loop emits when it drains and exits on
 # SIGTERM/SIGINT: an instant ("i"/"I") carrying the update count it
@@ -608,6 +632,70 @@ def validate_watchdog_dump(rec: object) -> list[str]:
             step = anomaly.get("step")
             if step is not None and not _is_number(step):
                 errors.append("anomaly: 'step' must be a number or null")
+    oom = rec.get("oom")
+    if oom is not None:
+        # An OOM forensics bundle (telemetry/memory.py): the same dump
+        # record with the failing error, the live-array census, and the
+        # per-device HBM stats attached.
+        errors.extend(_validate_oom_section(oom))
+    return errors
+
+
+def _validate_oom_section(oom: object) -> list[str]:
+    """The ``oom`` section of an OOM forensics bundle
+    (``fluxmpi_oom.<process>.json``, written by
+    ``telemetry/memory.write_oom_bundle``): the RESOURCE_EXHAUSTED
+    error string, the :func:`jax.live_arrays` census (top-N buffers by
+    nbytes with shape/dtype/sharding), normalized per-device memory
+    stats, and the process-lifetime peak watermark."""
+    if not isinstance(oom, dict):
+        return [f"'oom' must be an object, got {oom!r}"]
+    errors: list[str] = []
+    if not isinstance(oom.get("error"), str) or not oom.get("error"):
+        errors.append("oom: missing 'error' (str)")
+    census = oom.get("census")
+    if not isinstance(census, dict):
+        errors.append("oom: 'census' must be an object")
+    else:
+        for key in ("count", "total_bytes"):
+            v = census.get(key)
+            if not _is_int(v) or v < 0:
+                errors.append(f"oom: census {key!r} must be an int >= 0")
+        arrays = census.get("arrays")
+        if not isinstance(arrays, list):
+            errors.append("oom: census 'arrays' must be a list")
+            arrays = []
+        for i, a in enumerate(arrays):
+            aw = f"oom: census arrays[{i}]"
+            if not isinstance(a, dict):
+                errors.append(f"{aw}: not an object")
+                continue
+            if not _is_int(a.get("nbytes")) or a["nbytes"] < 0:
+                errors.append(f"{aw}: 'nbytes' must be an int >= 0")
+            shape = a.get("shape")
+            if not isinstance(shape, list) or not all(
+                _is_int(d) and d >= 0 for d in shape
+            ):
+                errors.append(f"{aw}: 'shape' must be a list of ints >= 0")
+            if not isinstance(a.get("dtype"), str) or not a.get("dtype"):
+                errors.append(f"{aw}: missing 'dtype' (str)")
+    devices = oom.get("devices")
+    if not isinstance(devices, dict):
+        errors.append("oom: 'devices' must be an object")
+    else:
+        for dev, stats in devices.items():
+            if not isinstance(dev, str) or not isinstance(stats, dict) or not all(
+                isinstance(k, str) and _is_number(v)
+                for k, v in stats.items()
+            ):
+                errors.append(
+                    f"oom: devices[{dev!r}] must map str stat keys to numbers"
+                )
+    watermark = oom.get("peak_watermark_bytes")
+    if watermark is not None and (
+        not _is_number(watermark) or watermark < 0
+    ):
+        errors.append("oom: 'peak_watermark_bytes' must be a number >= 0")
     return errors
 
 

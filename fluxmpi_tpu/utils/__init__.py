@@ -18,7 +18,15 @@ from .flops import (  # noqa: F401
     cost_analysis_flops,
     mfu,
 )
-from .profiling import profile_trace, step_timer  # noqa: F401
+from .profiling import (  # noqa: F401
+    AutoProfiler,
+    configure_auto_profiler,
+    get_auto_profiler,
+    maybe_auto_capture,
+    profile_trace,
+    set_auto_profiler,
+    step_timer,
+)
 from .ema import EMAState, ema_init, ema_params, ema_update  # noqa: F401
 from .precision import (  # noqa: F401
     DynamicLossScale,

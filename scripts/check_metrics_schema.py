@@ -10,7 +10,8 @@ Usage:
   (schema "fluxmpi_tpu.telemetry/v1"); a line carrying a ``bench`` key
   must also embed a valid bench record. Metric names in the
   framework-owned ``fault.`` / ``checkpoint.`` / ``goodput.`` /
-  ``anomaly.`` namespaces must come from ``schema.KNOWN_METRIC_NAMES``
+  ``anomaly.`` / ``compile.`` / ``memory.`` namespaces must come from
+  ``schema.KNOWN_METRIC_NAMES``
   (``fault.injected``, ``checkpoint.retries``, the run-health plane's
   ``goodput.bucket_seconds``/``goodput.mfu``/``anomaly.triggered``
   family; ``train.resumes`` and the ``train.preemption`` /
@@ -21,8 +22,14 @@ Usage:
   ``scripts/merge_traces.py`` output), a flight-recorder dump, or a
   watchdog hang dump. Anomaly diagnostics bundles
   (``fluxmpi_anomaly.<process>.json``, written by the
-  :class:`AnomalyDetector` on trigger) are watchdog-dump-kind records
-  with an extra ``anomaly`` section and validate through the same path.
+  :class:`AnomalyDetector` on trigger) and OOM forensics bundles
+  (``fluxmpi_oom.<process>.json``, written by ``train_loop`` when an
+  XLA ``RESOURCE_EXHAUSTED`` escapes the dispatch loop — live-array
+  census + per-device HBM stats + peak watermark) are
+  watchdog-dump-kind records with an extra ``anomaly`` / ``oom``
+  section and validate through the same path. The device plane's
+  ``compile.`` / ``memory.`` metric namespaces are closed like the
+  run-health ones — unknown names there fail the check.
 - ``*.json`` files carrying ``"schema": "fluxmpi_tpu.manifest/v1"``
   (the ``<step>.manifest.json`` topology sidecar every checkpoint save
   writes): validated against the manifest schema — leaf

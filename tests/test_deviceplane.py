@@ -1,0 +1,870 @@
+"""Device-plane tests (PR 9): compile/retrace telemetry from
+jax.monitoring, the steady_state_retrace anomaly rule (polymorphic step
+fires it after warmup, the PR 4 stable step does not), HBM gauges /
+census / the monitor fold, OOM forensics bundles from train_loop,
+anomaly-triggered auto-profiling, and the zero-cost-when-off contract
+(monkeypatch-explode)."""
+
+import json
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import fluxmpi_tpu as fm
+from fluxmpi_tpu.data import ArrayDataset, DistributedDataLoader
+from fluxmpi_tpu.parallel import TrainState, make_train_step, train_loop
+from fluxmpi_tpu.parallel.train import replicate
+from fluxmpi_tpu.telemetry import (
+    AnomalyDetector,
+    CompileMonitor,
+    GoodputTracker,
+    MetricsRegistry,
+    TrainingMonitor,
+    anomaly,
+    compileplane,
+    goodput,
+    memory,
+)
+from fluxmpi_tpu.telemetry import schema as tschema
+from fluxmpi_tpu.utils import AutoProfiler, profiling
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_CHECKER = os.path.join(_REPO, "scripts", "check_metrics_schema.py")
+
+
+@pytest.fixture()
+def device_plane_off():
+    """Guarantee the device + run-health planes are fully off around a
+    test and restore whatever was installed before."""
+    prev_cp = compileplane.set_compile_monitor(None)
+    prev_det = anomaly.set_anomaly_detector(None)
+    prev_gp = goodput.set_goodput_tracker(GoodputTracker(enabled=False))
+    prev_ap = profiling.set_auto_profiler(None)
+    was_mem = memory.enabled()
+    memory.shutdown()
+    try:
+        yield
+    finally:
+        compileplane.set_compile_monitor(prev_cp)
+        anomaly.set_anomaly_detector(prev_det)
+        goodput.set_goodput_tracker(prev_gp)
+        ap = profiling.set_auto_profiler(prev_ap)
+        if ap is not None and ap is not prev_ap:
+            ap.wait(timeout=90.0)
+        memory.shutdown()
+        if was_mem:
+            memory.configure(True)
+
+
+@pytest.fixture()
+def fake_xplane(monkeypatch):
+    """Stub jax.profiler's trace session for the unit tests: the real
+    backend's first session of a process pays a multi-second cold start
+    (budgeted once, in the e2e acceptance test). start_trace drops a
+    marker file so directory-walk assertions still mean something."""
+    def start(logdir, *a, **k):
+        os.makedirs(logdir, exist_ok=True)
+        with open(os.path.join(logdir, "fake.xplane.pb"), "a") as f:
+            f.write("x")
+    monkeypatch.setattr(jax.profiler, "start_trace", start)
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+
+
+def _mlp_pieces(n=256):
+    from fluxmpi_tpu.models import MLP
+
+    model = MLP(features=(16, 16, 1))
+
+    def loss_fn(p, ms, b):
+        bx, by = b
+        return jnp.mean((model.apply(p, bx) - by) ** 2), ms
+
+    opt = optax.adam(1e-3)
+    rng = np.random.default_rng(0)
+    x = rng.uniform(-2, 2, size=(n, 1)).astype(np.float32)
+    params = jax.device_get(
+        model.init(jax.random.PRNGKey(0), jnp.zeros((2, 1)))
+    )
+    return loss_fn, opt, params, ArrayDataset((x, x**2))
+
+
+def _fresh_state(params, opt, world):
+    return replicate(TrainState.create(params, opt, None), world)
+
+
+# ---------------------------------------------------------------------------
+# CompileMonitor
+# ---------------------------------------------------------------------------
+
+
+def test_compile_monitor_counts_and_attributes(device_plane_off):
+    reg = MetricsRegistry()
+    mon = CompileMonitor(registry=reg)
+    compileplane.set_compile_monitor(mon)
+
+    f = jax.jit(lambda x: x * 2 + 1)
+    mon.track("f", f)
+    f(jnp.ones(4)).block_until_ready()
+    info = mon.observe_flush(reg)
+    # Warmup flush: the first-dispatch compile is counted and attributed
+    # but NOT steady-state.
+    assert info["steady"] is False
+    assert info["events"] >= 1
+    assert "f" in info["functions"]
+    assert reg.counter("compile.events").value >= 1
+    assert reg.counter("compile.seconds", phase="compile").value > 0
+    assert (
+        reg.counter("compile.function_seconds", function="f").value > 0
+    )
+    # No retrace counter during warmup.
+    assert reg.counter("compile.retraces", function="f").value == 0
+
+    # Shape change: a steady-state retrace, named.
+    f(jnp.ones(16)).block_until_ready()
+    info = mon.observe_flush(reg)
+    assert info["steady"] is True
+    assert info["events"] >= 1
+    assert info["functions"] == ["f"]
+    assert reg.counter("compile.retraces", function="f").value == 1
+    assert mon.retraces and mon.retraces[-1]["functions"] == ["f"]
+
+    # A quiet interval reports nothing.
+    f(jnp.ones(16)).block_until_ready()
+    info = mon.observe_flush(reg)
+    assert info["events"] == 0
+    assert info["functions"] == []
+
+
+def test_compile_monitor_untracked_compiles_labeled(device_plane_off):
+    reg = MetricsRegistry()
+    mon = CompileMonitor(registry=reg)
+    compileplane.set_compile_monitor(mon)
+    mon.observe_flush(reg)  # warmup boundary, nothing tracked
+
+    g = jax.jit(lambda x: x - 3)  # never track()ed
+    g(jnp.ones(7)).block_until_ready()
+    info = mon.observe_flush(reg)
+    assert info["steady"] is True
+    assert info["events"] >= 1
+    assert info["functions"] == [compileplane.UNTRACKED]
+    assert (
+        reg.counter(
+            "compile.retraces", function=compileplane.UNTRACKED
+        ).value
+        >= 1
+    )
+
+
+def test_compile_monitor_goodput_crosscheck_gauge(device_plane_off):
+    reg = MetricsRegistry()
+    mon = CompileMonitor(registry=reg)
+
+    class _FakeTracker:
+        enabled = True
+
+        def bucket_seconds(self, name):
+            assert name == "compile"
+            return 0.05
+
+    # Forced totals: XLA reported 0.30s of compile work, the goodput
+    # plane only booked 0.05s as compile — 0.25s is hiding in other
+    # buckets (the silent-retrace signature).
+    with mon._lock:
+        mon._seconds = {"trace": 0.08, "lower": 0.02, "compile": 0.20}
+        mon._events = 2
+    mon.observe_flush(reg, goodput_tracker=_FakeTracker())
+    assert reg.gauge("compile.unattributed_seconds").value == pytest.approx(
+        0.25
+    )
+
+
+def test_compile_monitor_reset_run_reopens_warmup(device_plane_off):
+    reg = MetricsRegistry()
+    mon = CompileMonitor(registry=reg)
+    mon.observe_flush(reg)
+    assert mon.steady
+    # A new run window: warmup re-opens, so run 2's first-dispatch
+    # compiles are NOT steady-state retraces of run 1.
+    mon.reset_run()
+    assert not mon.steady
+    with mon._lock:
+        mon._events += 1
+        mon._seconds["compile"] += 0.1
+    info = mon.observe_flush(reg)
+    assert info["steady"] is False
+    assert mon.retraces == []
+
+
+def test_compile_monitor_crosscheck_is_per_run(device_plane_off):
+    """Pre-run compile seconds (model init, a previous loop) must not
+    count against the CURRENT run's goodput compile bucket."""
+    reg = MetricsRegistry()
+    mon = CompileMonitor(registry=reg)
+
+    class _FakeTracker:
+        enabled = True
+
+        def bucket_seconds(self, name):
+            return 0.05
+
+    with mon._lock:
+        mon._seconds = {"trace": 0.0, "lower": 0.0, "compile": 10.0}
+    mon.reset_run()  # train_loop start: 10s of pre-run compiles re-based
+    with mon._lock:
+        mon._seconds["compile"] += 0.30  # this run's compiles
+    mon.observe_flush(reg, goodput_tracker=_FakeTracker())
+    assert reg.gauge("compile.unattributed_seconds").value == pytest.approx(
+        0.25
+    )
+
+
+def test_second_train_loop_run_does_not_false_alarm(world, device_plane_off):
+    """Two sequential train_loop runs in one process: run 2's fresh step
+    compiles at ITS warmup, which must not fire steady_state_retrace."""
+    reg = MetricsRegistry()
+    mon = CompileMonitor(registry=reg)
+    compileplane.set_compile_monitor(mon)
+    det = AnomalyDetector(registry=reg, dump=False)
+    anomaly.set_anomaly_detector(det)
+    loss_fn, opt, params, ds = _mlp_pieces()
+    loader = DistributedDataLoader(ds, 64, mesh=world)
+    for _ in range(2):
+        step = make_train_step(loss_fn, opt, mesh=world)  # fresh jit
+        train_loop(
+            step, _fresh_state(params, opt, world), loader,
+            epochs=1, flush_every=2, metrics=reg,
+        )
+    assert det.triggered == []
+    assert mon.retraces == []
+
+
+def test_compileplane_configure_env_forms(device_plane_off, monkeypatch):
+    monkeypatch.delenv("FLUXMPI_TPU_COMPILEPLANE", raising=False)
+    assert compileplane.configure(None) is None
+    monkeypatch.setenv("FLUXMPI_TPU_COMPILEPLANE", "1")
+    mon = compileplane.configure(None)
+    assert isinstance(mon, CompileMonitor)
+    assert compileplane.configure(None) is mon  # idempotent replay
+    monkeypatch.setenv("FLUXMPI_TPU_COMPILEPLANE", "0")
+    assert compileplane.configure(None) is None
+    assert compileplane.get_compile_monitor() is None
+    with pytest.raises(ValueError):
+        compileplane.configure("sideways")
+
+
+def test_compileplane_off_never_subscribes(device_plane_off, monkeypatch):
+    """The no-subscribe half of the zero-cost contract: while the plane
+    is off, configure() touches jax.monitoring not at all; installing a
+    monitor registers the listener exactly then."""
+    import jax.monitoring
+
+    calls = []
+    monkeypatch.setattr(compileplane, "_listener_registered", False)
+    monkeypatch.setattr(
+        jax.monitoring,
+        "register_event_duration_secs_listener",
+        lambda cb: calls.append(cb),
+    )
+    monkeypatch.delenv("FLUXMPI_TPU_COMPILEPLANE", raising=False)
+    compileplane.configure(None)
+    compileplane.configure(False)
+    assert calls == []
+    mon = compileplane.configure(True)
+    assert calls == [compileplane._on_duration]
+    compileplane.set_compile_monitor(None)
+    assert mon is not None
+
+
+# ---------------------------------------------------------------------------
+# steady_state_retrace anomaly rule + auto-profile trigger
+# ---------------------------------------------------------------------------
+
+
+def test_retrace_rule_event_and_bundle(device_plane_off, tmp_path):
+    from fluxmpi_tpu.telemetry import tracing
+
+    tracer = tracing.Tracer(enabled=True)
+    prev = tracing.set_tracer(tracer)
+    try:
+        det = AnomalyDetector(dump_dir=str(tmp_path))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            events = det.observe(
+                retraces=3, retraced="train_loop.step", step=40
+            )
+        assert len(events) == 1
+        ev = events[0]
+        assert ev["rule"] == "steady_state_retrace"
+        assert ev["action"] == "warn"  # per-host signal: never halt
+        assert ev["value"] == 3.0
+        assert ev["function"] == "train_loop.step"
+        export = tracer.export()
+        assert tschema.validate_trace_export(export) == []
+        instants = [
+            e
+            for e in export["traceEvents"]
+            if e.get("name") == "anomaly.steady_state_retrace"
+        ]
+        assert len(instants) == 1
+        assert instants[0]["args"]["function"] == "train_loop.step"
+        # The bundle carries the function too, and validates.
+        bundle = json.loads(
+            (tmp_path / "fluxmpi_anomaly.0.json").read_text()
+        )
+        assert tschema.validate_watchdog_dump(bundle) == []
+        assert bundle["anomaly"]["function"] == "train_loop.step"
+    finally:
+        tracing.set_tracer(prev)
+
+
+def test_retrace_trigger_fires_auto_profiler(
+    device_plane_off, fake_xplane, tmp_path
+):
+    ap = AutoProfiler(str(tmp_path / "prof"), seconds=0.05, limit=1)
+    profiling.set_auto_profiler(ap)
+    det = AnomalyDetector(dump=False)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        det.observe(retraces=1, retraced="f", step=10)
+    assert ap.last_reason == "anomaly:steady_state_retrace"
+    ap.wait(timeout=90.0)
+    captured = [
+        os.path.join(r, f)
+        for r, _, fs in os.walk(ap.last_capture_path)
+        for f in fs
+    ]
+    assert captured, "no XPlane files written by the capture window"
+    # Rate limit: a second trigger in the same run is a no-op.
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        det.observe(retraces=1, retraced="f", step=20)
+    assert ap.captures == 1
+
+
+def test_step_time_regression_triggers_auto_profiler(
+    device_plane_off, fake_xplane, tmp_path
+):
+    ap = AutoProfiler(str(tmp_path / "prof"), seconds=0.05, limit=1)
+    profiling.set_auto_profiler(ap)
+    det = AnomalyDetector(dump=False, warmup=1)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        det.observe(step_seconds=0.1, step=1)
+        det.observe(step_seconds=0.1, step=2)
+        events = det.observe(step_seconds=10.0, step=3)
+    assert [e["rule"] for e in events] == ["step_time_regression"]
+    assert ap.last_reason == "anomaly:step_time_regression"
+    ap.wait(timeout=90.0)
+
+
+def test_non_performance_rule_does_not_profile(
+    device_plane_off, fake_xplane, tmp_path
+):
+    ap = AutoProfiler(str(tmp_path / "prof"), seconds=0.05, limit=1)
+    profiling.set_auto_profiler(ap)
+    det = AnomalyDetector(dump=False)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        det.observe(loss=float("nan"), step=1)
+    assert ap.captures == 0
+    assert ap.last_reason is None
+
+
+# ---------------------------------------------------------------------------
+# Memory plane
+# ---------------------------------------------------------------------------
+
+
+class _FakeDevice:
+    def __init__(self, stats):
+        self._stats = stats
+
+    def memory_stats(self):
+        return self._stats
+
+
+def test_device_memory_stats_normalization():
+    stats = memory.device_memory_stats(
+        _FakeDevice(
+            {
+                "bytes_in_use": 5,
+                "peak_bytes_in_use": 7,
+                "bytes_limit": 10,
+                "num_allocs": 3,  # not a gauge key: dropped
+            }
+        )
+    )
+    assert stats == {
+        "bytes_in_use": 5.0,
+        "peak_bytes_in_use": 7.0,
+        "bytes_limit": 10.0,
+    }
+    assert memory.device_memory_stats(_FakeDevice(None)) == {}
+
+    class _Broken:
+        def memory_stats(self):
+            raise RuntimeError("no stats on this backend")
+
+    assert memory.device_memory_stats(_Broken()) == {}
+
+
+def test_record_hbm_gauges_and_watermark(device_plane_off, monkeypatch):
+    devs = [
+        _FakeDevice({"bytes_in_use": 5, "peak_bytes_in_use": 70}),
+        _FakeDevice({"bytes_in_use": 6, "peak_bytes_in_use": 90}),
+    ]
+    monkeypatch.setattr(jax, "local_devices", lambda: devs)
+    reg = MetricsRegistry()
+    snap = memory.record_hbm(reg)
+    assert snap["local_peak_bytes"] == 90.0
+    assert snap["watermark_bytes"] == 90.0
+    assert reg.gauge("memory.bytes_in_use", device="1").value == 6.0
+    assert reg.gauge("memory.peak_bytes_in_use", device="0").value == 70.0
+    assert reg.gauge("memory.peak_watermark_bytes").value == 90.0
+    # Watermark is monotonic: a later, lower peak never lowers it.
+    devs[1]._stats["peak_bytes_in_use"] = 40
+    snap = memory.record_hbm(reg)
+    assert snap["local_peak_bytes"] == 70.0
+    assert snap["watermark_bytes"] == 90.0
+    assert memory.peak_watermark_bytes() == 90.0
+
+
+def test_census_top_n_ordering():
+    big = jnp.ones((256, 64))
+    small = jnp.ones((4,))
+    c = memory.census(top_n=2)
+    assert c["count"] >= 2
+    assert c["total_bytes"] >= int(big.nbytes) + int(small.nbytes)
+    assert len(c["arrays"]) == 2
+    sizes = [a["nbytes"] for a in c["arrays"]]
+    assert sizes == sorted(sizes, reverse=True)
+    assert sizes[0] >= int(big.nbytes)
+    top = c["arrays"][0]
+    assert isinstance(top["shape"], list)
+    assert isinstance(top["dtype"], str)
+    assert isinstance(top["sharding"], str)
+    del big, small
+
+
+def test_memory_configure_env_forms(device_plane_off, monkeypatch):
+    monkeypatch.delenv("FLUXMPI_TPU_MEMORY", raising=False)
+    assert memory.configure(None) is False
+    monkeypatch.setenv("FLUXMPI_TPU_MEMORY", "1")
+    assert memory.configure(None) is True
+    assert memory.enabled()
+    monkeypatch.setenv("FLUXMPI_TPU_MEMORY", "0")
+    assert memory.configure(None) is False
+    with pytest.raises(ValueError):
+        memory.configure("sideways")
+
+
+# ---------------------------------------------------------------------------
+# TrainingMonitor: dedupe + the HBM fold
+# ---------------------------------------------------------------------------
+
+
+def test_monitor_device_memory_routes_through_helper(
+    device_plane_off, monkeypatch
+):
+    """Satellite: the monitor's device.memory.* series reads through the
+    ONE normalization helper in telemetry/memory.py."""
+    monkeypatch.setattr(
+        memory,
+        "device_memory_stats",
+        lambda d: {"bytes_in_use": 42.0},
+    )
+    reg = MetricsRegistry()
+    mon = TrainingMonitor(registry=reg, interval=1, cross_host=False)
+    mon.collect()
+    assert (
+        reg.gauge("device.memory.bytes_in_use", device="0").value == 42.0
+    )
+
+
+def test_monitor_folds_hbm_peak_when_plane_on(device_plane_off, monkeypatch):
+    devs = [_FakeDevice({"bytes_in_use": 10, "peak_bytes_in_use": 77})]
+    monkeypatch.setattr(jax, "local_devices", lambda: devs)
+    memory.configure(True)
+    reg = MetricsRegistry()
+    mon = TrainingMonitor(registry=reg, interval=1, cross_host=False)
+    summary = mon.observe_step(0.1)
+    assert summary["hbm_peak_bytes_max"] == 77.0
+    assert summary["hbm_peak_bytes_min"] == 77.0
+    assert reg.gauge("monitor.hbm_peak_bytes_mean").value == 77.0
+    # The one device walk also feeds the legacy device.memory.* series.
+    assert reg.gauge("device.memory.peak_bytes_in_use", device="0").value == 77.0
+
+
+def test_monitor_no_hbm_fold_when_plane_off(device_plane_off):
+    reg = MetricsRegistry()
+    mon = TrainingMonitor(registry=reg, interval=1, cross_host=False)
+    summary = mon.observe_step(0.1)
+    assert "hbm_peak_bytes_max" not in summary
+    assert all(
+        m["name"] != "monitor.hbm_peak_bytes_mean" for m in reg.snapshot()
+    )
+
+
+# ---------------------------------------------------------------------------
+# OOM forensics in train_loop
+# ---------------------------------------------------------------------------
+
+
+def _oom_step_pieces(fail_at=3, message=None):
+    message = message or (
+        "RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+        "1073741824 bytes."
+    )
+    calls = {"n": 0}
+
+    def step(state, batch):
+        calls["n"] += 1
+        if calls["n"] >= fail_at:
+            raise RuntimeError(message)
+        return state, jnp.zeros(())
+
+    batches = [
+        (np.zeros((8, 1), np.float32), np.zeros((8, 1), np.float32))
+        for _ in range(6)
+    ]
+    return step, jnp.zeros(()), batches
+
+
+def test_train_loop_oom_writes_census_bundle(
+    device_plane_off, tmp_path, monkeypatch
+):
+    monkeypatch.setenv("FLUXMPI_TPU_OOM_DIR", str(tmp_path))
+    step, state, batches = _oom_step_pieces()
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            train_loop(step, state, batches, epochs=1)
+    path = tmp_path / "fluxmpi_oom.0.json"
+    assert path.exists()
+    rec = json.loads(path.read_text())
+    assert tschema.validate_watchdog_dump(rec) == []
+    assert rec["kind"] == "watchdog_dump"
+    assert rec["reason"] == "oom"
+    assert "RESOURCE_EXHAUSTED" in rec["oom"]["error"]
+    assert rec["oom"]["census"]["count"] >= 1
+    assert isinstance(rec["oom"]["devices"], dict)
+    # The repo checker validates it like every other artifact.
+    proc = subprocess.run(
+        [sys.executable, _CHECKER, str(path)],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+
+
+def test_train_loop_non_oom_error_writes_no_bundle(
+    device_plane_off, tmp_path, monkeypatch
+):
+    monkeypatch.setenv("FLUXMPI_TPU_OOM_DIR", str(tmp_path))
+    step, state, batches = _oom_step_pieces(message="some unrelated crash")
+    with pytest.raises(RuntimeError, match="unrelated"):
+        train_loop(step, state, batches, epochs=1)
+    assert not (tmp_path / "fluxmpi_oom.0.json").exists()
+
+
+def test_is_oom_error_matching():
+    assert memory.is_oom_error(
+        RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating 8 bytes")
+    )
+    assert memory.is_oom_error(RuntimeError("Allocator ran Out of Memory"))
+    assert not memory.is_oom_error(ValueError("shape mismatch"))
+
+
+# ---------------------------------------------------------------------------
+# Auto-profiler + profile_trace collision fix
+# ---------------------------------------------------------------------------
+
+
+def test_auto_profiler_rate_limit_and_force(
+    device_plane_off, fake_xplane, tmp_path
+):
+    ap = AutoProfiler(str(tmp_path), seconds=0.05, limit=1)
+    # An early SIGUSR2 capture must NOT spend the automatic budget — the
+    # one auto capture exists for a later anomaly's evidence.
+    forced = ap.maybe_capture("human", force=True)
+    assert forced is not None
+    ap.wait(timeout=90.0)
+    first = ap.maybe_capture("one")
+    assert first is not None
+    ap.wait(timeout=90.0)
+    assert ap.maybe_capture("two") is None  # auto budget spent
+    assert ap.captures == 2
+    forced = ap.maybe_capture("human-again", force=True)  # still allowed
+    assert forced is not None
+    ap.wait(timeout=90.0)
+    assert ap.captures == 3
+    ap.reset()
+    assert ap.maybe_capture("fresh-run") is not None
+    ap.wait(timeout=90.0)
+
+
+def test_auto_profiler_refunds_budget_when_start_fails(
+    device_plane_off, tmp_path, monkeypatch
+):
+    """A capture that collides with a live profiler session (start_trace
+    raises) must refund the budget — the armed profiler exists to
+    guarantee one capture of XPlane evidence."""
+    attempts = []
+
+    def flaky_start(logdir, *a, **k):
+        attempts.append(logdir)
+        if len(attempts) == 1:
+            raise RuntimeError("Only one profile may be run at a time.")
+        os.makedirs(logdir, exist_ok=True)
+        with open(os.path.join(logdir, "fake.xplane.pb"), "a") as f:
+            f.write("x")
+
+    monkeypatch.setattr(jax.profiler, "start_trace", flaky_start)
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+    ap = AutoProfiler(str(tmp_path), seconds=0.05, limit=1)
+    assert ap.maybe_capture("one") is not None  # collides, refunded
+    ap.wait(timeout=90.0)
+    assert ap.captures == 0
+    assert ap.maybe_capture("two") is not None  # budget still available
+    ap.wait(timeout=90.0)
+    assert ap.captures == 1
+    assert len(attempts) == 2
+
+
+def test_train_loop_resets_auto_capture_budget_per_run(
+    world, device_plane_off, fake_xplane, tmp_path
+):
+    """The 'once per run' budget is per train_loop run: a capture spent
+    in run 1 must not leave run 2's regression evidence-less."""
+    ap = AutoProfiler(str(tmp_path), seconds=0.05, limit=1)
+    profiling.set_auto_profiler(ap)
+    assert ap.maybe_capture("run1-anomaly") is not None
+    ap.wait(timeout=90.0)
+    assert ap.maybe_capture("still-run1") is None  # budget spent
+    anomaly.set_anomaly_detector(AnomalyDetector(dump=False))
+    loss_fn, opt, params, ds = _mlp_pieces()
+    loader = DistributedDataLoader(ds, 64, mesh=world)
+    step = make_train_step(loss_fn, opt, mesh=world)
+    train_loop(step, _fresh_state(params, opt, world), loader, epochs=1)
+    assert ap.maybe_capture("run2-anomaly") is not None  # budget re-opened
+    ap.wait(timeout=90.0)
+
+
+def test_auto_profiler_configure_idempotent_keeps_budget(
+    device_plane_off, fake_xplane, tmp_path, monkeypatch
+):
+    monkeypatch.setenv("FLUXMPI_TPU_PROFILE_DIR", str(tmp_path))
+    monkeypatch.setenv("FLUXMPI_TPU_PROFILE_SECONDS", "0.1")
+    monkeypatch.setenv("FLUXMPI_TPU_PROFILE_LIMIT", "1")
+    ap = profiling.configure_auto_profiler(None)
+    assert isinstance(ap, AutoProfiler)
+    assert ap.seconds == 0.1
+    ap.maybe_capture("x")
+    ap.wait(timeout=90.0)
+    # init() replay with the same spec keeps the instance AND its spent
+    # budget — a replay must not grant a fresh capture.
+    assert profiling.configure_auto_profiler(None) is ap
+    assert ap.captures == 1
+    profiling.configure_auto_profiler("0")
+    assert profiling.get_auto_profiler() is None
+
+
+def test_profile_trace_all_hosts_gets_proc_subdir(monkeypatch):
+    """Satellite: profile_trace(all_hosts=True) writes each process into
+    <logdir>/proc<k> instead of documenting the collision away."""
+    captured = []
+
+    class _FakeTrace:
+        def __init__(self, logdir):
+            captured.append(logdir)
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return None
+
+    monkeypatch.setattr(jax.profiler, "trace", _FakeTrace)
+    monkeypatch.setattr(jax, "process_count", lambda: 4)
+    monkeypatch.setattr(jax, "process_index", lambda: 2)
+    with profiling.profile_trace("/logs/run1", all_hosts=True):
+        pass
+    assert captured == [os.path.join("/logs/run1", "proc2")]
+    # Non-lead process without all_hosts: no trace at all.
+    with profiling.profile_trace("/logs/run1"):
+        pass
+    assert len(captured) == 1
+    # Single-process all_hosts keeps the plain logdir (no nesting).
+    monkeypatch.setattr(jax, "process_count", lambda: 1)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    with profiling.profile_trace("/logs/run1", all_hosts=True):
+        pass
+    assert captured[-1] == "/logs/run1"
+
+
+# ---------------------------------------------------------------------------
+# Schema: closed compile./memory. namespaces
+# ---------------------------------------------------------------------------
+
+
+def test_compile_memory_namespaces_are_closed():
+    ok = {
+        "name": "memory.bytes_in_use",
+        "type": "gauge",
+        "labels": {"device": "0"},
+        "value": 1.0,
+    }
+    assert tschema.validate_metric(ok) == []
+    for bogus in ("compile.bogus", "memory.bogus"):
+        bad = {"name": bogus, "type": "gauge", "labels": {}, "value": 1.0}
+        assert any(
+            "framework-owned" in e for e in tschema.validate_metric(bad)
+        )
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: retrace detection + stable-step negative + zero-cost
+# ---------------------------------------------------------------------------
+
+
+def _polymorphic_batches(n_stable=8, n_poly=4):
+    """Batches whose shape changes mid-run — the silent retrace: batch
+    size 64 for the first n_stable dispatches, then 80."""
+    rng = np.random.default_rng(0)
+    for i in range(n_stable + n_poly):
+        n = 64 if i < n_stable else 80
+        x = rng.uniform(-2, 2, size=(n, 1)).astype(np.float32)
+        yield (x, (x**2).astype(np.float32))
+
+
+def test_retrace_end_to_end(world, device_plane_off, tmp_path, monkeypatch):
+    """Acceptance: an injected mid-run retrace emits compile.* metrics,
+    fires steady_state_retrace naming the recompiled function, and drops
+    a profile capture in FLUXMPI_TPU_PROFILE_DIR."""
+    reg = MetricsRegistry()
+    mon = CompileMonitor(registry=reg)
+    compileplane.set_compile_monitor(mon)
+    det = AnomalyDetector(
+        registry=reg, dump_dir=str(tmp_path / "bundles")
+    )
+    anomaly.set_anomaly_detector(det)
+    prof_dir = tmp_path / "profiles"
+    monkeypatch.setenv("FLUXMPI_TPU_PROFILE_DIR", str(prof_dir))
+    monkeypatch.setenv("FLUXMPI_TPU_PROFILE_SECONDS", "0.2")
+    ap = profiling.configure_auto_profiler(None)
+
+    loss_fn, opt, params, _ = _mlp_pieces()
+    step = make_train_step(loss_fn, opt, mesh=world)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        state, summary = train_loop(
+            step,
+            _fresh_state(params, opt, world),
+            _polymorphic_batches(),
+            flush_every=4,
+            metrics=reg,
+        )
+    assert summary["updates"] == 12
+    # compile.* metrics: the warmup compile AND the retrace are counted;
+    # the retrace is attributed to the loop's tagged hot step.
+    assert reg.counter("compile.events").value >= 2
+    assert (
+        reg.counter(
+            "compile.retraces", function="train_loop.step"
+        ).value
+        >= 1
+    )
+    # The rule fired, naming the function...
+    rules = [ev["rule"] for ev in det.triggered]
+    assert "steady_state_retrace" in rules
+    ev = next(
+        e for e in det.triggered if e["rule"] == "steady_state_retrace"
+    )
+    assert "train_loop.step" in ev["function"]
+    assert ev["action"] == "warn"  # and the run completed
+    # ...the diagnostics bundle is on disk and valid...
+    bundle = json.loads(
+        (tmp_path / "bundles" / "fluxmpi_anomaly.0.json").read_text()
+    )
+    assert tschema.validate_watchdog_dump(bundle) == []
+    # ...and the auto-profiler dropped an XPlane capture.
+    assert ap.captures == 1
+    ap.wait(timeout=90.0)
+    captured = [
+        os.path.join(r, f)
+        for r, _, fs in os.walk(str(prof_dir))
+        for f in fs
+    ]
+    assert captured, "no profile capture landed in FLUXMPI_TPU_PROFILE_DIR"
+
+
+def test_stable_step_never_fires_retrace(world, device_plane_off):
+    """The PR 4 stable loop (loader-fed, fixed shapes, multi-epoch) must
+    stay silent: its only compiles are warmup."""
+    reg = MetricsRegistry()
+    mon = CompileMonitor(registry=reg)
+    compileplane.set_compile_monitor(mon)
+    det = AnomalyDetector(registry=reg, dump=False)
+    anomaly.set_anomaly_detector(det)
+
+    loss_fn, opt, params, ds = _mlp_pieces()
+    loader = DistributedDataLoader(ds, 64, mesh=world)
+    step = make_train_step(loss_fn, opt, mesh=world)
+    state, summary = train_loop(
+        step,
+        _fresh_state(params, opt, world),
+        loader,
+        epochs=2,
+        flush_every=2,
+        metrics=reg,
+    )
+    assert summary["updates"] == 8
+    assert det.triggered == []
+    assert mon.retraces == []
+    assert (
+        reg.counter("compile.retraces", function="train_loop.step").value
+        == 0
+    )
+
+
+def test_train_loop_fully_off_device_plane_costs_nothing(
+    world, device_plane_off, monkeypatch
+):
+    """The PR 4 monkeypatch-explode contract extended to the device
+    plane: with no compile monitor, memory plane off, and no
+    auto-profiler, the train loop performs no monitoring subscriptions,
+    no compile-cache polls, no HBM stat reads, and no census walks."""
+    assert compileplane.get_compile_monitor() is None
+    assert not memory.enabled()
+    assert profiling.get_auto_profiler() is None
+
+    def boom(*a, **k):
+        raise AssertionError("device plane touched on the off path")
+
+    monkeypatch.setattr(CompileMonitor, "track", boom)
+    monkeypatch.setattr(CompileMonitor, "observe_flush", boom)
+    monkeypatch.setattr(compileplane, "_ensure_listener", boom)
+    monkeypatch.setattr(memory, "record_hbm", boom)
+    monkeypatch.setattr(memory, "census", boom)
+    monkeypatch.setattr(memory, "write_oom_bundle", boom)
+    monkeypatch.setattr(memory, "is_oom_error", boom)
+    monkeypatch.setattr(AutoProfiler, "maybe_capture", boom)
+    monkeypatch.setattr(AutoProfiler, "reset", boom)
+    monkeypatch.setattr(profiling, "maybe_auto_capture", boom)
+
+    loss_fn, opt, params, ds = _mlp_pieces()
+    loader = DistributedDataLoader(ds, 64, mesh=world)
+    step = make_train_step(loss_fn, opt, mesh=world)
+    state, summary = train_loop(
+        step, _fresh_state(params, opt, world), loader, epochs=1
+    )
+    assert summary["updates"] == 4
+    assert summary["anomaly"] is None
