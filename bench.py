@@ -143,20 +143,15 @@ def _anchor_for(metric: str) -> float | None:
 
 def _enable_compilation_cache() -> None:
     """Persist compiled XLA programs so repeat bench runs skip the (slow)
-    first compile. TPU only: XLA:CPU persists AOT executables keyed too
-    loosely — an entry compiled on a host with different CPU features loads
-    anyway ("may SIGILL") and in practice kills device threads, wedging
-    8-device collective rendezvous."""
-    import jax
-
-    if jax.default_backend() != "tpu":
-        return
-    cache_dir = os.environ.get(
-        "FLUXMPI_TPU_COMPILE_CACHE", "/tmp/fluxmpi_tpu_xla_cache"
-    )
+    first compile — delegated to the ONE runtime implementation
+    (:func:`fluxmpi_tpu.runtime.enable_compile_cache`, the same knob
+    ``init(compile_cache=)`` / ``FLUXMPI_TPU_COMPILE_CACHE`` wire for
+    training runs). TPU only; the helper documents why XLA:CPU
+    persistence is unsafe."""
     try:
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        from fluxmpi_tpu.runtime import enable_compile_cache
+
+        enable_compile_cache()
     except Exception:
         pass
 
@@ -323,6 +318,7 @@ def _bench_workload(
     value_scale: float = 1.0,
     init_fn=None,
     default_scan_steps: int = 1,
+    fused_ab: bool = False,
 ):
     """Shared harness: synthetic batch → compiled DP train step → per-chip
     throughput. ``make_model_batch(n_dev)`` returns
@@ -374,6 +370,11 @@ def _bench_workload(
         steps = max(2, min(steps, int(cap)))
     step = make_train_step(loss_fn, optimizer, mesh=mesh, style="auto",
                            remat=remat)
+    # Host copies for the fused A/B's fresh states: the timed steps
+    # donate the replicated state, and replicate() may alias device
+    # inputs — building a second TrainState from consumed params would
+    # hit deleted arrays.
+    host_params = jax.device_get(params) if fused_ab else None
     state = replicate(TrainState.create(params, optimizer, model_state), mesh)
     data = shard_batch((x, y), mesh)
 
@@ -463,6 +464,19 @@ def _bench_workload(
                 result["assembly_samples_per_sec"] = round(
                     fed["assembly_samples_per_sec"], 1
                 )
+
+    if fused_ab:
+        ab = _fused_window_ab(
+            loss_fn=loss_fn, optimizer=optimizer, host_params=host_params,
+            mesh=mesh, n_dev=n_dev, x=x, y=y,
+        )
+        if ab is not None:
+            # One-program flush windows (train_loop fuse="window") vs
+            # the pipelined per-batch path over the SAME loader-fed
+            # workload: throughput + dispatches-per-update per leg, so
+            # the 1-dispatch-per-window claim is asserted in the record
+            # rather than inferred.
+            result["fused_window"] = ab
     return result
 
 
@@ -539,6 +553,80 @@ def _loader_fed_rate(*, step, state, x, y, mesh, n_dev) -> dict | None:
         return out
     except Exception as exc:  # pragma: no cover - diagnostics only
         print(f"bench: loader-fed path failed: {exc!r}", file=sys.stderr)
+        return None
+
+
+def _fused_window_ab(
+    *, loss_fn, optimizer, host_params, mesh, n_dev, x, y
+) -> dict | None:
+    """A/B the one-program flush window (train_loop ``fuse="window"``:
+    batch gather + the window's updates + metric reduction fused into
+    one dispatch per window) against the pipelined per-batch path, on a
+    loader-fed workload sized so the epoch is one window. Each leg
+    reports per-chip throughput and — the directly-asserted claim —
+    ``dispatches_per_update`` from the loop's own dispatch counter: 1.0
+    pipelined, ``1/window`` fused."""
+    import jax
+
+    from fluxmpi_tpu.data import ArrayDataset, DistributedDataLoader
+    from fluxmpi_tpu.parallel import TrainState, make_train_step, train_loop
+    from fluxmpi_tpu.parallel.train import replicate
+
+    try:
+        window = 8  # batches per epoch == updates per fused window
+        lbs = 16
+        gbs = lbs * n_dev
+        n = gbs * window
+        host_x = np.asarray(jax.device_get(x))
+        host_y = np.asarray(jax.device_get(y))
+        reps = -(-n // host_x.shape[0])
+        host_x = np.concatenate([host_x] * reps, axis=0)[:n]
+        host_y = np.concatenate([host_y] * reps, axis=0)[:n]
+        dataset = ArrayDataset((host_x, host_y))
+        step = make_train_step(loss_fn, optimizer, mesh=mesh)
+        epochs = 2
+
+        def run(fuse):
+            loader = DistributedDataLoader(dataset, gbs, mesh=mesh)
+            st = replicate(
+                TrainState.create(host_params, optimizer, None), mesh
+            )
+            _, summary = train_loop(
+                step, st, loader, epochs=epochs, fuse=fuse,
+                flush_every=window, metrics=False,
+            )
+            return summary
+
+        legs = {}
+        for name, fuse in (("pipelined", False), ("fused", "window")):
+            run(fuse)  # warmup: jit + the window's AOT compile (cached)
+            s = run(fuse)
+            legs[name] = {
+                "samples_per_sec_per_chip": round(
+                    s["examples_per_sec"] / n_dev, 1
+                ),
+                "dispatches_per_update": round(
+                    s["dispatches"] / s["updates"], 4
+                ),
+            }
+        if legs["fused"].get("dispatches_per_update", 1.0) >= 1.0:
+            print("bench: fused A/B did not engage fusion", file=sys.stderr)
+            return None
+        pipelined_dpu = legs["pipelined"]["dispatches_per_update"]
+        fused_dpu = legs["fused"]["dispatches_per_update"]
+        return {
+            "window": window,
+            "pipelined": legs["pipelined"],
+            "fused": legs["fused"],
+            "dispatch_reduction": round(pipelined_dpu / fused_dpu, 2),
+            "speedup": round(
+                legs["fused"]["samples_per_sec_per_chip"]
+                / legs["pipelined"]["samples_per_sec_per_chip"],
+                3,
+            ) if legs["pipelined"]["samples_per_sec_per_chip"] > 0 else None,
+        }
+    except Exception as exc:  # pragma: no cover - diagnostics only
+        print(f"bench: fused A/B failed: {exc!r}", file=sys.stderr)
         return None
 
 
@@ -646,6 +734,9 @@ def _bench_mlp():
         # FLUXMPI_TPU_BENCH_SCAN_STEPS=1 restores per-step dispatch for
         # A/B; rates and FLOPs account for the scan width either way.
         default_scan_steps=8,
+        # One-program flush windows vs the pipelined loader-fed path —
+        # the A/B rides the mlp child (and hence both scaling legs).
+        fused_ab=True,
     )
 
 
@@ -1227,6 +1318,20 @@ def _leg_breakdown(rec: dict) -> dict:
         out["dispatch_us"] = dispatch.get("per_dispatch_us")
     if "scan_steps" in rec:
         out["scan_steps"] = rec["scan_steps"]
+    fused = rec.get("fused_window")
+    if isinstance(fused, dict):
+        # The fused-vs-pipelined dispatch accounting per leg: how many
+        # host dispatches one optimizer update costs on each path, and
+        # the reduction factor the one-program window buys.
+        out["fused_window"] = {
+            "window": fused.get("window"),
+            "pipelined_dispatches_per_update": (fused.get("pipelined") or {})
+            .get("dispatches_per_update"),
+            "fused_dispatches_per_update": (fused.get("fused") or {})
+            .get("dispatches_per_update"),
+            "dispatch_reduction": fused.get("dispatch_reduction"),
+            "speedup": fused.get("speedup"),
+        }
     return out
 
 

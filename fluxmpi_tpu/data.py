@@ -48,6 +48,37 @@ __all__ = [
 _DEVICE_GATHER_DEFAULT_MAX_BYTES = 256 * 1024 * 1024
 
 
+def _device_gather_budget() -> int:
+    """The FLUXMPI_TPU_DEVICE_GATHER_MAX_BYTES budget, defaulting (with a
+    warning, not a crash) on a malformed value — a typo'd env var used to
+    raise ValueError from deep inside epoch setup."""
+    raw = os.environ.get("FLUXMPI_TPU_DEVICE_GATHER_MAX_BYTES")
+    if not raw:
+        return _DEVICE_GATHER_DEFAULT_MAX_BYTES
+    try:
+        return int(raw)
+    except ValueError:
+        import warnings
+
+        warnings.warn(
+            f"FLUXMPI_TPU_DEVICE_GATHER_MAX_BYTES={raw!r} is not an "
+            f"integer byte count; falling back to the 256 MiB default",
+            stacklevel=2,
+        )
+        return _DEVICE_GATHER_DEFAULT_MAX_BYTES
+
+
+def _gather_batch(data: Any, perm: Any, start: Any, lbs: int) -> Any:
+    """One batch from the device-resident dataset: a dynamic slice of the
+    epoch permutation plus a per-leaf take. Pure and traceable — the ONE
+    copy of the gather math, jit-wrapped per batch by the loader's
+    device-gather path and traced INSIDE the fused-window program
+    (:func:`fluxmpi_tpu.parallel.train.make_window_program`), so both
+    paths consume identical batches by construction."""
+    idx = jax.lax.dynamic_slice_in_dim(perm, start, lbs)
+    return jax.tree_util.tree_map(lambda a: jnp.take(a, idx, axis=0), data)
+
+
 class ArrayDataset:
     """A dataset backed by a pytree of equal-length host arrays.
 
@@ -701,12 +732,7 @@ class DistributedDataLoader:
             # is single-controller. Host path keeps multi-process correct.
             return False
         if self.device_gather == "auto":
-            budget = int(
-                os.environ.get(
-                    "FLUXMPI_TPU_DEVICE_GATHER_MAX_BYTES",
-                    str(_DEVICE_GATHER_DEFAULT_MAX_BYTES),
-                )
-            )
+            budget = _device_gather_budget()
             nbytes = sum(
                 np.asarray(leaf).nbytes
                 for leaf in jax.tree_util.tree_leaves(backing[0])
@@ -739,14 +765,77 @@ class DistributedDataLoader:
         lbs = self.local_batch_size
 
         def gather(data, perm, start):
-            idx = jax.lax.dynamic_slice_in_dim(perm, start, lbs)
-            return jax.tree_util.tree_map(
-                lambda a: jnp.take(a, idx, axis=0), data
-            )
+            return _gather_batch(data, perm, start, lbs)
 
         fn = jax.jit(gather, out_shardings=out_sharding)
         self._gather_cache = (arrays, mesh, staged, fn, replicated)
         return staged, fn, replicated
+
+    # -- fused-window pass (train_loop fuse="window") -------------------
+    #
+    # The pipelined device-gather path still pays one host dispatch per
+    # batch (the jitted gather) plus one per step. The fused-window
+    # driver moves the WHOLE flush window on device — gathers and steps
+    # alike traced into one program — so instead of iterating, it asks
+    # the loader for the epoch's device-resident pieces and accounts
+    # consumption explicitly. Same epoch order, same staged arrays, same
+    # state_dict/resume contract as iterating.
+
+    def fusible(self) -> bool:
+        """Can the fused-window driver drive this loader? Requires the
+        device-gather path to be active for the current dataset/mesh
+        (array-backed, single-process, no ``transform``, within the
+        staging budget) and an epoch of whole full-width batches (a
+        ragged tail would need the host path mid-window)."""
+        backing = (
+            self._container_source(self.data)[1]
+            if self.global_shuffle
+            else self._array_backing()
+        )
+        if backing is None or not self._use_device_gather(backing):
+            return False
+        return len(self) * self.local_batch_size <= self._common_len
+
+    def device_epoch(self) -> tuple[Any, Any, int]:
+        """Begin one fused-window pass: resolve this epoch's order (the
+        same seeded permutation iterating would use), stage the dataset
+        into device memory (cached across epochs), and transfer the
+        epoch permutation once. Returns ``(staged, perm, start)`` — the
+        replicated dataset pytree, the replicated ``int32`` permutation
+        (backing offset applied, global-index form), and the batch index
+        to start from (a pending mid-epoch resume cursor, else 0).
+        Advances the same epoch/cursor bookkeeping as ``iter()``; the
+        caller reports consumption via :meth:`note_consumed`."""
+        if not self.fusible():
+            raise ValueError(
+                "device_epoch() needs the device-gather path: an "
+                "array-backed single-process dataset without transform=, "
+                "within FLUXMPI_TPU_DEVICE_GATHER_MAX_BYTES, and a whole "
+                "number of full batches per epoch"
+            )
+        order, _, backing = self._epoch_plan()
+        epoch_now = self._epoch
+        self._epoch += 1
+        arrays, offset = backing
+        staged, _, replicated = self._gather_state(arrays)
+        lbs = self.local_batch_size
+        perm = jax.device_put(
+            np.asarray(order[: len(self) * lbs], dtype=np.int32)
+            + np.int32(offset),
+            replicated,
+        )
+        start = self._resume_cursor
+        self._resume_cursor = 0
+        self._iter_epoch = epoch_now
+        self._cursor = start
+        return staged, perm, start
+
+    def note_consumed(self, n: int) -> None:
+        """Advance the consumption cursor by ``n`` batches — the fused
+        driver's analogue of the per-yield increment in ``__iter__``, so
+        :meth:`state_dict` captured at a window boundary names exactly
+        the batches dispatched (the resume contract)."""
+        self._cursor += int(n)
 
     def _timed_batches(self) -> Iterator[Any]:
         """The batch source with per-batch fetch latency observed into the
@@ -846,7 +935,14 @@ class DistributedDataLoader:
             self._cursor += 1
             yield queue.popleft()
 
-    def _iter_batches(self) -> Iterator[Any]:
+    def _epoch_plan(self) -> tuple[Any, Any, tuple[Any, int] | None]:
+        """Resolve the CURRENT epoch's iteration order: ``(order, source,
+        backing)`` where ``order`` indexes ``source`` (or, offset by
+        ``backing[1]``, the backing arrays). One copy of the epoch-order
+        policy, shared by the pipelined iterator (:meth:`_iter_batches`)
+        and the fused-window pass (:meth:`device_epoch`) so both consume
+        the exact same sample sequence. Reads ``self._epoch`` without
+        advancing it — callers own the bookkeeping."""
         if (
             self.elastic_order
             and jax.process_count() > 1
@@ -896,6 +992,10 @@ class DistributedDataLoader:
                 rng = np.random.default_rng(self.seed + self._epoch)
                 rng.shuffle(order)
             backing = self._array_backing()
+        return order, source, backing
+
+    def _iter_batches(self) -> Iterator[Any]:
+        order, source, backing = self._epoch_plan()
         epoch_now = self._epoch  # the epoch the shuffle rngs above used
         self._epoch += 1
         sharding = self._sharding()

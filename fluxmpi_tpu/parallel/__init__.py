@@ -38,5 +38,6 @@ from .train import (  # noqa: F401
     TrainState,
     make_eval_step,
     make_train_step,
+    make_window_program,
 )
 from .loop import train_loop  # noqa: F401  (after .train: loop imports it)

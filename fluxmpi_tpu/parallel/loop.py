@@ -27,6 +27,14 @@ and tf.data's pipelined input processing (Murray et al., VLDB 2021).
   :class:`~fluxmpi_tpu.data.DistributedDataLoader` in
   :func:`~fluxmpi_tpu.data.scan_batches` automatically — the adapter the
   compiled multi-step path was missing;
+- **one-program flush windows** (``fuse="window"``, auto-enabled) — when
+  the loader's device-gather path is active, the whole window fuses into
+  ONE AOT-compiled ``lax.scan`` program: batch gather from the
+  device-resident dataset, ``flush_every`` optimizer updates, and the
+  interval metric reduction all run on device with the train state
+  donated as the carry — the host performs one dispatch and one tiny
+  metrics transfer per window instead of per-batch gather+step dispatch
+  pairs (docs/performance.md, "One-program windows");
 - **flush-boundary instrumentation** — telemetry and watchdog hooks run
   every ``flush_every`` updates (and at the end), not per step: the
   steady state pays zero per-step host blocking for metrics, and the
@@ -136,6 +144,79 @@ def _maybe_oom_forensics(exc: BaseException, registry: Any) -> None:
         )
 
 
+def _fused_window_width(
+    step: Any,
+    batches: Any,
+    flush_every: int,
+    steps: int | None,
+    scan_k: int,
+    forced: bool,
+) -> int:
+    """Resolve the fused-window width for ``train_loop(fuse=...)``: the
+    number of optimizer updates one compiled window program drives, or 0
+    when the fused path cannot drive this (step, loader) pair. ``forced``
+    (``fuse="window"``) raises naming the failing condition instead of
+    falling back.
+
+    The width is ``flush_every`` clamped to the epoch length (an epoch
+    shorter than the flush interval fuses as one window per pass), and
+    the epoch must divide into whole windows — a ragged trailing window
+    would recompile every epoch."""
+    from ..data import DistributedDataLoader
+
+    def fail(reason: str) -> int:
+        if forced:
+            raise ValueError(f'fuse="window" unavailable: {reason}')
+        return 0
+
+    if not isinstance(batches, DistributedDataLoader):
+        return fail("batches is not a DistributedDataLoader")
+    if getattr(step, "__fluxmpi_window_meta__", None) is None:
+        return fail(
+            "the step carries no fused-window metadata — build it with "
+            "make_train_step(style='auto')"
+        )
+    if not batches.fusible():
+        return fail(
+            "the loader's device-gather path is not active (needs an "
+            "array-backed single-process dataset without transform=, "
+            "within FLUXMPI_TPU_DEVICE_GATHER_MAX_BYTES, whole full "
+            "batches per epoch)"
+        )
+    nb = len(batches)
+    if nb < 1:
+        return fail("the loader has no full batches")
+    width = min(flush_every, nb)
+    if nb % width:
+        return fail(
+            f"epoch of {nb} batches does not divide into flush_every="
+            f"{flush_every} windows (width {width}) — pick a flush_every "
+            f"that divides the epoch"
+        )
+    if not forced and steps is not None and steps % width:
+        # Window dispatch quantizes the steps budget (round up to whole
+        # windows, like scan_steps quantizes to scan groups). Forcing
+        # fuse="window" opts into that documented rounding; AUTO must
+        # not silently change how many updates `steps` means, so it
+        # keeps the pipelined path for misaligned budgets. (Windows stay
+        # on the `width` grid across resumes — the short realignment
+        # window restores it — so alignment here is alignment always.)
+        return 0
+    if not forced and scan_k > 1 and (
+        nb % scan_k or (steps is not None and steps % scan_k)
+    ):
+        # Same rule for the scan quantum: the pipelined path's
+        # scan_batches adapter DROPS the ragged trailing scan group
+        # ((nb // k) * k updates per epoch) and rounds a steps budget
+        # UP to whole scan groups, while the fused window — which
+        # sequences single updates itself — would train all nb batches
+        # and stop on the window grid. AUTO must not silently change
+        # what an epoch or a steps budget means for a scan_steps step;
+        # forcing fuse="window" opts into the window quantization.
+        return 0
+    return width
+
+
 def _batch_examples(batch: Any, scan_steps: int) -> int:
     leaves = jax.tree_util.tree_leaves(batch)
     if not leaves or not getattr(leaves[0], "ndim", 0):
@@ -156,6 +237,7 @@ def train_loop(
     scan_steps: int | None = None,
     in_flight: int = 2,
     flush_every: int = 50,
+    fuse: Any = "auto",
     metrics: Any | None = None,
     checkpoint: Any | None = None,
     save_every: int | None = None,
@@ -192,7 +274,43 @@ def train_loop(
       flush_every: updates between instrumentation flushes. A flush
         blocks on the newest outstanding result (draining the pipeline),
         records interval aggregates, and ticks the watchdog — the ONLY
-        places this driver blocks besides the final drain.
+        places this driver blocks besides the final drain. Under
+        ``fuse="window"`` this is also the window width (clamped to the
+        epoch length): every window boundary is a flush boundary.
+      fuse: ``"auto"`` (default) engages **one-program flush windows**
+        when the loader's device-gather path is active and the epoch
+        divides into ``flush_every``-update windows: batch gather, the
+        window's optimizer updates, and the interval metric reduction
+        (loss last/sum/max, grad-norm) are traced into ONE compiled
+        ``lax.scan`` program per window — the host performs one dispatch
+        and one tiny device→host metrics transfer per flush window
+        instead of ``flush_every`` gather+step dispatch pairs. The train
+        state is donated (carry updates in place in HBM) and the program
+        is AOT-lowered (``jit(...).lower().compile()``) at loop start —
+        booked into the goodput ``compile`` bucket, attributed by the
+        compile monitor as ``train_loop.window``, and banked in the
+        persistent compilation cache when one is wired
+        (``init(compile_cache=)`` / ``FLUXMPI_TPU_COMPILE_CACHE``).
+        ``"window"`` forces the fused path (raises naming the failing
+        condition when ineligible); ``False``/``None`` keeps the
+        pipelined per-batch path. Fused excludes what the device-gather
+        path excludes — ``transform=``, generic/multi-process datasets,
+        ragged epochs keep the host path — and metric/anomaly/preemption
+        granularity moves to window boundaries (watchdog liveness too:
+        the loop ticks once per window dispatch and once per flush, and
+        the host blocks a full window draining it — size an armed
+        watchdog's stall deadline above one window's wall time); a
+        ``scan_steps`` tag on the step is subsumed (the window IS the
+        scan), and ``steps`` budgets round up to whole windows —
+        ``"auto"`` therefore keeps the pipelined path when ``steps`` is
+        not a multiple of the window, or when a ``scan_steps`` step
+        meets a ragged epoch its stacking adapter would have truncated,
+        so it never silently changes how many updates a budget means.
+        The resume contract is
+        unchanged: a checkpoint cursor landing inside a window (a
+        pipelined run's save, or an elastic remap) resumes with one
+        shorter first window, sample-exact. See docs/performance.md,
+        "One-program windows".
       metrics: same spec as :func:`make_train_step` (``True`` = default
         registry, a registry/monitor, or a callable receiving the
         interval record). ``None`` (default) inherits the spec the step
@@ -288,8 +406,11 @@ def train_loop(
       ``examples``, ``seconds``, ``updates_per_sec``,
       ``examples_per_sec``, final ``loss``, ``preempted``,
       ``resumed_from`` (the checkpoint step resumed from, else None),
-      ``anomaly`` (the halting rule, else None), and — goodput enabled
-      only — ``goodput`` (the tracker's
+      ``anomaly`` (the halting rule, else None), ``dispatches`` (host
+      dispatches of the compiled program — ``dispatches/updates`` is
+      the per-update host cost the fused path shrinks),
+      ``fused_window`` (the engaged window width, else None), and —
+      goodput enabled only — ``goodput`` (the tracker's
       :meth:`~fluxmpi_tpu.telemetry.GoodputTracker.report`).
     """
     from ..data import DistributedDataLoader
@@ -320,6 +441,24 @@ def train_loop(
     # grad_norm)) — handled uniformly below via tree leaves. (NOT
     # __wrapped__: jax.jit sets that too, to the *uncompiled* function.)
     hot = getattr(step, "__fluxmpi_compiled__", step)
+
+    fused_w = 0
+    if fuse not in (False, None):
+        if fuse not in ("auto", "window"):
+            raise ValueError(
+                f'fuse must be "auto", "window", False, or None; '
+                f"got {fuse!r}"
+            )
+        fused_w = _fused_window_width(
+            hot, batches, flush_every, steps, k, forced=fuse == "window"
+        )
+    orig_k = k
+    if fused_w:
+        # The window program sequences single updates itself: the step's
+        # scan_steps tag (and the stacking adapter) are bypassed, and
+        # budgets / checkpoint cursors quantize to batches, not scan
+        # groups.
+        k = 1
 
     if metrics is None:
         # Honor the spec the step was built with (docstring contract):
@@ -361,6 +500,12 @@ def train_loop(
         # would inherit run 1's steady-state mark and report its own
         # legitimate warmup compiles as retraces.
         cp.track("train_loop.step", hot)
+        if fused_w:
+            # The fused path dispatches AOT executables, which never
+            # grow a jit cache — attribution and steady-state retrace
+            # detection come from explicit note_aot_compile() calls at
+            # lower() time instead.
+            cp.track_aot("train_loop.window")
         cp.reset_run()
     if det_on:
         # The anomaly-triggered auto-profiler budgets captures PER RUN
@@ -404,9 +549,12 @@ def train_loop(
     updates = 0
     examples = 0
     epochs_done = 0
+    dispatches = 0  # host dispatches of the hot/window program
     interval_updates = 0
     interval_examples = 0
+    interval_windows = 0  # fused mode: windows since the last flush
     last_out: Any = None
+    last_width = fused_w  # fused mode: width of the last window
 
     def _live_registry() -> Any:
         return get_registry() if reg is _DEFAULT_REGISTRY else reg
@@ -547,6 +695,23 @@ def train_loop(
                 batches.load_state_dict(
                     {key: int(val) for key, val in restored["loader"].items()}
                 )
+                if fused_w and fuse == "auto" and steps is not None:
+                    # Same-geometry resumes keep updates ≡ cursor
+                    # (mod width) — windows then land exactly on an
+                    # aligned steps budget. An ELASTIC geometry remap
+                    # breaks the congruence (cursor rescales, updates
+                    # doesn't), and window boundaries would straddle
+                    # the budget and overshoot it. AUTO's rule — never
+                    # silently change what `steps` means — extends
+                    # here: fall back to the pipelined path (restoring
+                    # the step's own scan quantum for the reseat
+                    # below); fuse="window" keeps the rounding opt-in.
+                    pos0 = batches.resume_cursor
+                    short_first = (fused_w - pos0 % fused_w) % fused_w
+                    if (steps - updates - short_first) % fused_w:
+                        fused_w = 0
+                        k = orig_k
+                        per_epoch = _epoch_len(batches, k)
                 # load_state_dict normalized an end-of-epoch cursor away
                 # (the banked epoch count already includes that pass —
                 # _payload's canonical form); what remains is mid-epoch
@@ -584,6 +749,130 @@ def train_loop(
         checkpoint.save(updates, _payload(state, pass_counted=pass_counted))
         last_saved = updates
 
+    def _post_dispatch(at_flush: bool) -> None:
+        """Dispatch-boundary bookkeeping shared by the pipelined and
+        fused paths, in commit order: flush (and honor a halt-policy
+        anomaly), check the steps budget, bank the boundary, then honor
+        a pending preemption (whose emergency save then has nothing
+        left to write). In fused mode every window boundary is a flush
+        boundary, so all of this runs once per window."""
+        nonlocal done, preempted
+        if at_flush:
+            flush()
+            if halt_rule is not None:
+                # An anomaly with a halt policy: stop at this flush
+                # boundary (SPMD-consistent — every process reached
+                # it at the same updates count and judged the same
+                # global scalars) WITHOUT banking a checkpoint of
+                # the now-suspect state; the last periodic save
+                # holds the last known-good boundary.
+                done = True
+        if steps is not None and updates >= steps:
+            done = True
+        if (
+            checkpoint is not None
+            and save_every is not None
+            and halt_rule is None
+            and updates - last_saved >= save_every
+        ):
+            _save_ckpt()
+        if multi:
+            # Coordinated stop: a local break would leave the other
+            # processes dispatching collectives this one never joins
+            # (a hang), or desync the emergency save's step-agreement
+            # guard. Every process reaches each flush boundary at
+            # the SAME updates count, so one tiny host max-reduce of
+            # the flag there picks a common stop step. An ungated
+            # multi-process run never breaks locally — that would be
+            # the hang; preemption there needs handlers/checkpoint.
+            if coordinate and at_flush and bool(
+                _comm.host_allreduce(
+                    np.int32(preemption_requested()), op="max"
+                )
+            ):
+                preempted = True
+                done = True
+        elif preemption_requested():
+            preempted = True
+            done = True
+
+    lbs_fused = batches.local_batch_size if fused_w else 0
+    gbs_fused = batches.global_batch_size if fused_w else 0
+
+    def _aval_key(tree: Any) -> tuple:
+        """Hashable (structure, shapes, dtypes) fingerprint of a pytree —
+        the part of the cache key that makes a banked AOT executable
+        safe to reuse. A jit cache keys on avals natively; an AOT
+        executable checks nothing, so dispatching one compiled for a
+        DIFFERENT dataset/state shape would crash (or worse)."""
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        return (
+            treedef,
+            tuple(
+                (np.shape(leaf), str(getattr(leaf, "dtype", "?")))
+                for leaf in leaves
+            ),
+        )
+
+    flops_probed = False  # one cost-model probe per run, hit or miss
+
+    def _window_program(
+        width: int, cur_state: Any, staged: Any, perm: Any, avals: tuple
+    ):
+        """The compiled window program for ``width`` updates: built by
+        :func:`~fluxmpi_tpu.parallel.train.make_window_program`,
+        AOT-lowered (``lower().compile()``) ONCE up front — booked as
+        goodput compile work and attributed by the compile monitor as
+        ``train_loop.window`` — and cached on the step across
+        train_loop runs (the persistent compilation cache, when wired,
+        covers restarts and other hosts). Lowering reads only avals, so
+        the live pre-dispatch state is safe to pass."""
+        cache = getattr(hot, "__fluxmpi_window_cache__", None)
+        if cache is None:
+            cache = {}
+            try:
+                hot.__fluxmpi_window_cache__ = cache
+            except (AttributeError, TypeError):  # pragma: no cover
+                pass
+        key = (width, lbs_fused) + avals
+        prog = cache.get(key)
+        if prog is None:
+            from .train import make_window_program
+
+            fn = make_window_program(hot, width=width, lbs=lbs_fused)
+            t0 = time.perf_counter() if cp_on else 0.0
+            if gp_on:
+                with gp.segment("compile"):
+                    prog = fn.lower(
+                        cur_state, staged, perm, np.int32(0)
+                    ).compile()
+            else:
+                prog = fn.lower(
+                    cur_state, staged, perm, np.int32(0)
+                ).compile()
+            if cp_on:
+                cp.note_aot_compile(
+                    "train_loop.window", time.perf_counter() - t0
+                )
+            cache[key] = prog
+        nonlocal flops_probed
+        if gp_on and not flops_probed and gp._flops_per_update is None:
+            # FLOPs per update from the window executable's cost model —
+            # the same accounting the pipelined path gets from
+            # cost_analysis_flops, so live MFU is path-independent. On
+            # the CACHE-HIT path too: reset_run() cleared the per-run
+            # FLOPs, and a second run reusing the banked executable must
+            # still report MFU. One probe per run either way — a backend
+            # whose cost model reports no FLOPs must not be re-asked
+            # every window.
+            flops_probed = True
+            from ..utils.flops import executable_flops
+
+            flops = executable_flops(prog)
+            if flops:
+                gp.set_flops_per_update(flops / width)
+        return prog
+
     t_start = time.perf_counter()
     t_flush = t_start
 
@@ -593,8 +882,8 @@ def train_loop(
     stall_base = gp.bucket_seconds("data_stall") if gp_on else 0.0
 
     def flush() -> None:
-        nonlocal interval_updates, interval_examples, t_flush
-        nonlocal halt_rule, stall_base
+        nonlocal interval_updates, interval_examples, interval_windows
+        nonlocal t_flush, halt_rule, stall_base
         if interval_updates == 0:
             return
         if last_out is not None:
@@ -613,12 +902,32 @@ def train_loop(
         notify_progress(interval_updates)
         loss_v: float | None = None
         grad_v: float | None = None
+        window_stats: dict[str, float] = {}
         if record_metrics or det_on:
-            leaves = jax.tree_util.tree_leaves(last_out)
-            loss_h = np.asarray(jax.device_get(leaves[0])) if leaves else None
-            loss_v = float(loss_h.mean()) if loss_h is not None else None
-            if len(leaves) > 1:
-                grad_v = float(np.asarray(jax.device_get(leaves[1])).mean())
+            if fused_w:
+                # The window program's metric carry: a dict of f32
+                # scalars — ONE tiny device→host transfer per flush.
+                vals = jax.device_get(last_out)
+                loss_v = float(np.asarray(vals["loss"]))
+                if "grad_norm" in vals:
+                    grad_v = float(np.asarray(vals["grad_norm"]))
+                if last_width > 0:
+                    window_stats["loss_window_mean"] = (
+                        float(np.asarray(vals["loss_sum"])) / last_width
+                    )
+                window_stats["loss_window_max"] = float(
+                    np.asarray(vals["loss_max"])
+                )
+            else:
+                leaves = jax.tree_util.tree_leaves(last_out)
+                loss_h = (
+                    np.asarray(jax.device_get(leaves[0])) if leaves else None
+                )
+                loss_v = float(loss_h.mean()) if loss_h is not None else None
+                if len(leaves) > 1:
+                    grad_v = float(
+                        np.asarray(jax.device_get(leaves[1])).mean()
+                    )
         if record_metrics:
             record: dict[str, Any] = {
                 "step_seconds": per_update,
@@ -631,6 +940,7 @@ def train_loop(
             }
             if grad_v is not None:
                 record["grad_norm"] = grad_v
+            record.update(window_stats)
             registry = _live_registry()
             if registry is not None:
                 registry.histogram("train.step_seconds").observe(per_update)
@@ -643,6 +953,14 @@ def train_loop(
                 )
                 registry.counter("train.steps").inc(interval_updates)
                 registry.counter("train.examples").inc(interval_examples)
+                if fused_w:
+                    # The fused path's host-cost contract, observable in
+                    # the JSONL stream: windows dispatched and the width
+                    # each one fused.
+                    registry.gauge("train.window.size").set(float(fused_w))
+                    registry.counter("train.window.dispatches").inc(
+                        interval_windows
+                    )
             if monitor is not None:
                 monitor.observe_step(per_update)
             if hook is not None:
@@ -684,6 +1002,7 @@ def train_loop(
                     halt_rule = ev["rule"]
         interval_updates = 0
         interval_examples = 0
+        interval_windows = 0
         t_flush = time.perf_counter()
 
     done = False
@@ -705,6 +1024,91 @@ def train_loop(
         dispatched_this_epoch = offset
         yielded_this_pass = 0
         exhausted = False
+        if fused_w:
+            # ---- one-program flush windows ----------------------------
+            # The loader hands over the device-resident pieces (staged
+            # dataset, this epoch's permutation, the resume start) and
+            # the host then performs ONE dispatch per window: gathers,
+            # the window's updates, and the metric reduction all run
+            # inside the compiled program. The host wait for the epoch
+            # bring-up (permutation transfer) is the fused analogue of
+            # the loader stall.
+            if gp_on:
+                clock = gp._clock
+                t0 = clock()
+                staged, perm, pos = batches.device_epoch()
+                gp.add("data_stall", clock() - t0)
+            else:
+                staged, perm, pos = batches.device_epoch()
+            nb = per_epoch
+            # The cache-key fingerprint is invariant within a pass (the
+            # program returns same-aval state by construction; staged
+            # and perm are fixed per epoch): compute it ONCE here, not
+            # per window — per-dispatch tree walks are exactly the host
+            # work this path exists to remove.
+            avals = (_aval_key(state), _aval_key(staged), _aval_key(perm))
+            if pos % fused_w:
+                # Mid-window resume: the short realignment window
+                # dispatches (and flushes) first, which would mark the
+                # run steady BEFORE the full-width program compiles —
+                # and a legitimate warmup compile must never read as a
+                # steady_state_retrace (or burn the auto-profiler's
+                # once-per-run capture). Pre-build the full program now,
+                # during warmup, when the budget says one will run.
+                short = fused_w - pos % fused_w
+                full_window_later = pos + short < nb or (
+                    epochs is None or epochs_done + 1 < epochs
+                )
+                if full_window_later and (
+                    steps is None or steps - updates > short
+                ):
+                    _window_program(fused_w, state, staged, perm, avals)
+            while pos < nb:
+                # A resume cursor landing inside a window (a pipelined
+                # run's checkpoint, an elastic remap) realigns with ONE
+                # shorter first window — sample-exact, and the flush
+                # grid matches the uninterrupted run's from then on.
+                width = fused_w - pos % fused_w if pos % fused_w else fused_w
+                program = _window_program(width, state, staged, perm, avals)
+                start_idx = np.int32(pos * lbs_fused)
+                if gp_on:
+                    # The dispatch is the whole window's productive
+                    # compute; the flush inside _post_dispatch drains it
+                    # under its own step segment.
+                    with gp.segment("step"):
+                        state, out = program(state, staged, perm, start_idx)
+                    gp.note_updates(width)
+                else:
+                    state, out = program(state, staged, perm, start_idx)
+                first_dispatch = False
+                last_out = out
+                last_width = width
+                dispatches += 1
+                # Watchdog liveness: the fused path never iterates the
+                # loader, so the loader's per-fetch tick is gone — tick
+                # per window dispatch instead (one int increment, kept
+                # even with telemetry off, same as the loader's). The
+                # host still blocks a whole window inside the flush
+                # drain: size the watchdog deadline above one window's
+                # wall time (see the fuse= docstring).
+                notify_progress()
+                batches.note_consumed(width)
+                pos += width
+                updates += width
+                examples += width * gbs_fused
+                interval_updates += width
+                interval_examples += width * gbs_fused
+                interval_windows += 1
+                yielded_this_pass += 1
+                # Every window boundary is a flush boundary: metrics,
+                # anomaly rules, checkpoint saves, and preemption all
+                # quantize to windows in fused mode.
+                _post_dispatch(True)
+                if done:
+                    break
+            if pos >= nb:
+                epochs_done += 1
+            continue
         source = _epoch_iter(batches, k)
         if gp_on:
             # Loader waits land in the data_stall bucket; the off path
@@ -741,6 +1145,7 @@ def train_loop(
                     jax.block_until_ready(window.popleft())
             first_dispatch = False
             last_out = out
+            dispatches += 1
             n = _batch_examples(batch, k)
             updates += k
             examples += n
@@ -748,48 +1153,7 @@ def train_loop(
             interval_examples += n
             dispatched_this_epoch += 1
             yielded_this_pass += 1
-            at_flush = interval_updates >= flush_every
-            if at_flush:
-                flush()
-                if halt_rule is not None:
-                    # An anomaly with a halt policy: stop at this flush
-                    # boundary (SPMD-consistent — every process reached
-                    # it at the same updates count and judged the same
-                    # global scalars) WITHOUT banking a checkpoint of
-                    # the now-suspect state; the last periodic save
-                    # holds the last known-good boundary.
-                    done = True
-            if steps is not None and updates >= steps:
-                done = True
-            # Dispatch-boundary fault-tolerance hooks, in commit order:
-            # bank the boundary first, then honor a pending preemption
-            # (whose emergency save then has nothing left to write).
-            if (
-                checkpoint is not None
-                and save_every is not None
-                and halt_rule is None
-                and updates - last_saved >= save_every
-            ):
-                _save_ckpt()
-            if multi:
-                # Coordinated stop: a local break would leave the other
-                # processes dispatching collectives this one never joins
-                # (a hang), or desync the emergency save's step-agreement
-                # guard. Every process reaches each flush boundary at
-                # the SAME updates count, so one tiny host max-reduce of
-                # the flag there picks a common stop step. An ungated
-                # multi-process run never breaks locally — that would be
-                # the hang; preemption there needs handlers/checkpoint.
-                if coordinate and at_flush and bool(
-                    _comm.host_allreduce(
-                        np.int32(preemption_requested()), op="max"
-                    )
-                ):
-                    preempted = True
-                    done = True
-            elif preemption_requested():
-                preempted = True
-                done = True
+            _post_dispatch(interval_updates >= flush_every)
             if done:
                 break
         else:
@@ -846,9 +1210,12 @@ def train_loop(
     seconds = time.perf_counter() - t_start
     loss = None
     if last_out is not None:
-        leaves = jax.tree_util.tree_leaves(last_out)
-        if leaves:
-            loss = float(np.asarray(jax.device_get(leaves[0])).mean())
+        if fused_w:
+            loss = float(np.asarray(jax.device_get(last_out["loss"])))
+        else:
+            leaves = jax.tree_util.tree_leaves(last_out)
+            if leaves:
+                loss = float(np.asarray(jax.device_get(leaves[0])).mean())
     summary = {
         "updates": updates,
         "epochs": epochs_done,
@@ -860,6 +1227,12 @@ def train_loop(
         "preempted": preempted,
         "resumed_from": resumed_from,
         "anomaly": halt_rule,
+        # Host dispatches of the compiled hot/window program — the
+        # number the fused path exists to shrink (1 per window vs 1 per
+        # batch); dispatches/updates is the bench's directly-asserted
+        # dispatch cost.
+        "dispatches": dispatches,
+        "fused_window": fused_w or None,
     }
     if gp_on:
         # Final record covers the drain/emergency-save tail the last

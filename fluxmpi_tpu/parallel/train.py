@@ -45,6 +45,7 @@ __all__ = [
     "TrainState",
     "make_train_step",
     "make_eval_step",
+    "make_window_program",
     "replicate",
     "shard_batch",
 ]
@@ -435,6 +436,7 @@ def make_train_step(
                 new_ts, loss = _apply_update(ts, grads, l / k, ms)
                 return _result(new_ts, loss, grads)
 
+        single_update = step  # the one-update body the fused window scans
         if scan_steps > 1:
             single = step
 
@@ -443,7 +445,8 @@ def make_train_step(
 
         replicated = NamedSharding(mesh, P())
         state_in = replicated if state_sharding is None else state_sharding
-        spec = P(name) if batch_spec is None else batch_spec
+        single_spec = P(name) if batch_spec is None else batch_spec
+        spec = single_spec
         if scan_steps > 1:
             # Leading scan axis is time, not data: unsharded.
             spec = P(None, *spec)
@@ -457,6 +460,22 @@ def make_train_step(
             donate_argnums=(0,) if donate else (),
         )
         _tag_scan_steps(compiled, scan_steps)
+        # Everything make_window_program needs to re-fuse this step's math
+        # into a one-program flush window (batch gather + K updates +
+        # metric reduction in a single lax.scan). The SINGLE-update body
+        # rides along — the window does its own scan, so a scan_steps
+        # wrapper here is irrelevant to the fused path.
+        try:
+            compiled.__fluxmpi_window_meta__ = {
+                "single": single_update,
+                "state_in": state_in,
+                "batch_spec": single_spec,
+                "mesh": mesh,
+                "donate": donate,
+                "instrument": instrument,
+            }
+        except (AttributeError, TypeError):  # pragma: no cover - jax-version
+            pass
         if instrument:
             return _instrument_step(compiled, metrics, scan_steps)
         return compiled
@@ -499,6 +518,105 @@ def make_train_step(
     if instrument:
         return _instrument_step(compiled, metrics, 1)
     return compiled
+
+
+def make_window_program(
+    step: Any,
+    *,
+    width: int,
+    lbs: int,
+) -> Any:
+    """Fuse a whole flush window into ONE jitted program: ``width``
+    sequential optimizer updates, each batch gathered from the
+    device-resident dataset inside the scan, with the interval metrics
+    (last/sum/max loss, last grad-norm for instrumented steps) folded
+    into the scan carry.
+
+    The returned callable has signature ``(state, data, perm, start) ->
+    (state, metrics)`` where ``data`` is the staged (replicated) dataset
+    pytree and ``perm`` the epoch permutation from
+    :meth:`fluxmpi_tpu.data.DistributedDataLoader.device_epoch`, and
+    ``start`` is the first sample offset (``batch_cursor × lbs``, a
+    traced scalar — windows at different positions share one
+    executable). ``metrics`` is a dict of f32 scalars: ``loss`` (the
+    last update's, the value the pipelined flush reports), ``loss_sum``
+    / ``loss_max`` over the window, plus ``grad_norm`` when the step was
+    built with ``metrics=``. The train state is donated (per the step's
+    own ``donate`` setting) so the carry updates in place in HBM — the
+    host performs one dispatch and one tiny device→host metrics transfer
+    per window instead of ``width`` gather+step dispatch pairs.
+
+    ``step`` must come from ``make_train_step(style="auto")`` — the
+    factory banks the single-update body and sharding layout it needs
+    (``__fluxmpi_window_meta__``); the batch gather is the same
+    :func:`fluxmpi_tpu.data._gather_batch` math the per-batch
+    device-gather path jits, so both paths consume identical batches.
+    ``train_loop(fuse="window")`` builds, AOT-compiles
+    (``.lower().compile()``), and caches these per width — see
+    docs/performance.md, "One-program windows".
+    """
+    from ..data import _gather_batch
+
+    meta = getattr(step, "__fluxmpi_window_meta__", None)
+    if meta is None:
+        raise ValueError(
+            "make_window_program needs a step built by "
+            "make_train_step(style='auto') — shard_map-style and foreign "
+            "steps carry no fused-window metadata"
+        )
+    if width < 1:
+        raise ValueError(f"width must be >= 1, got {width}")
+    single = meta["single"]
+    mesh = meta["mesh"]
+    instrument = meta["instrument"]
+    batch_sharding = NamedSharding(mesh, meta["batch_spec"])
+    replicated = NamedSharding(mesh, P())
+
+    def window(ts: TrainState, data, perm, start):
+        def body(carry, i):
+            st, m = carry
+            batch = _gather_batch(data, perm, start + i * lbs, lbs)
+            # Pin the gathered batch to the step's data-parallel layout
+            # so the partitioner sees exactly what the per-batch gather
+            # jit's out_shardings produced.
+            batch = jax.lax.with_sharding_constraint(batch, batch_sharding)
+            out = single(st, batch)
+            if instrument:
+                new_st, (loss, gnorm) = out
+            else:
+                new_st, loss = out
+                gnorm = None
+            # f32 carry: exact for f32/bf16 losses, and float() of the
+            # device_get'd value matches the pipelined flush bit for bit.
+            loss32 = loss.astype(jnp.float32)
+            new_m = {
+                "loss": loss32,
+                "loss_sum": m["loss_sum"] + loss32,
+                "loss_max": jnp.maximum(m["loss_max"], loss32),
+            }
+            if instrument:
+                new_m["grad_norm"] = gnorm.astype(jnp.float32)
+            return (new_st, new_m), None
+
+        m0 = {
+            "loss": jnp.zeros((), jnp.float32),
+            "loss_sum": jnp.zeros((), jnp.float32),
+            "loss_max": jnp.full((), -jnp.inf, jnp.float32),
+        }
+        if instrument:
+            m0["grad_norm"] = jnp.zeros((), jnp.float32)
+        (new_ts, metrics), _ = jax.lax.scan(
+            body, (ts, m0), jnp.arange(width, dtype=jnp.int32)
+        )
+        return new_ts, metrics
+
+    window.__name__ = f"fluxmpi_window_{width}"
+    return jax.jit(
+        window,
+        in_shardings=(meta["state_in"], replicated, replicated, replicated),
+        out_shardings=(meta["state_in"], replicated),
+        donate_argnums=(0,) if meta["donate"] else (),
+    )
 
 
 def make_eval_step(

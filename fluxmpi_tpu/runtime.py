@@ -44,6 +44,7 @@ __all__ = [
     "init",
     "is_initialized",
     "Initialized",
+    "enable_compile_cache",
     "shutdown",
     "local_rank",
     "total_workers",
@@ -195,6 +196,79 @@ def _configure_preemption(spec: Any = None) -> None:
     install_preemption_handlers(_SIGNALS_BY_NAME[spec])
 
 
+# ---------------------------------------------------------------------------
+# Persistent XLA compilation cache: fleet-scale cold start pays compile
+# once (shared storage), not once per host — the AOT-lowered fused-window
+# programs and every other jit land in it.
+# ---------------------------------------------------------------------------
+
+_COMPILE_CACHE_ENV = "FLUXMPI_TPU_COMPILE_CACHE"
+_COMPILE_CACHE_DEFAULT_DIR = "/tmp/fluxmpi_tpu_xla_cache"
+
+
+def enable_compile_cache(cache_dir: str | None = None) -> bool:
+    """Point XLA's persistent compilation cache at ``cache_dir`` (default
+    ``FLUXMPI_TPU_COMPILE_CACHE``, else ``/tmp/fluxmpi_tpu_xla_cache``)
+    so repeat runs — and, on shared storage, every host of a fleet —
+    skip the slow first compile. Returns True when enabled.
+
+    TPU only: XLA:CPU persists AOT executables keyed too loosely — an
+    entry compiled on a host with different CPU features loads anyway
+    ("may SIGILL") and in practice kills device threads, wedging
+    multi-device collective rendezvous. On other backends this is a
+    no-op (with a warning when the cache was explicitly requested)."""
+    import jax
+
+    explicit = cache_dir is not None or bool(
+        os.environ.get(_COMPILE_CACHE_ENV)
+    )
+    if cache_dir is None:
+        cache_dir = (
+            os.environ.get(_COMPILE_CACHE_ENV) or _COMPILE_CACHE_DEFAULT_DIR
+        )
+    if jax.default_backend() != "tpu":
+        if explicit:
+            warnings.warn(
+                "persistent compile cache skipped: XLA:CPU persists AOT "
+                "executables keyed too loosely across hosts (stale "
+                "entries can SIGILL device threads); the cache is "
+                "TPU-only",
+                stacklevel=2,
+            )
+        return False
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # pragma: no cover - jax-version dependent
+        return False
+    return True
+
+
+def _configure_compile_cache(spec: Any = None) -> None:
+    """Wire the persistent compile cache from a one-value spec (mirror
+    of ``telemetry.configure``): ``None`` reads
+    ``FLUXMPI_TPU_COMPILE_CACHE`` (no-op when unset); a path string
+    enables the cache there; ``True``/``"1"`` enables the default
+    location; ``False``/``"0"`` is a no-op (the cache config is
+    process-global jax state — there is nothing to detach)."""
+    if spec is None:
+        spec = os.environ.get(_COMPILE_CACHE_ENV)
+        if spec is None or spec == "":
+            return
+    if spec is False or spec == "0":
+        return
+    if spec is True or spec == "1":
+        enable_compile_cache()
+        return
+    if isinstance(spec, str):
+        enable_compile_cache(spec)
+        return
+    raise ValueError(
+        f"compile_cache spec must be a bool, '0'/'1', or a directory "
+        f"path; got {spec!r}"
+    )
+
+
 def _should_init_distributed() -> bool:
     """Heuristic for joining a multi-host world at ``init()``.
 
@@ -230,6 +304,7 @@ def init(
     compileplane: Any = None,
     memory: Any = None,
     profile: Any = None,
+    compile_cache: Any = None,
 ) -> Mesh:
     """Bring up the fluxmpi_tpu runtime. Idempotent.
 
@@ -318,6 +393,13 @@ def init(
         ``None`` defers to ``FLUXMPI_TPU_PROFILE_DIR`` (window/limit
         from ``FLUXMPI_TPU_PROFILE_SECONDS`` /
         ``FLUXMPI_TPU_PROFILE_LIMIT``).
+      compile_cache: point XLA's persistent compilation cache at a
+        directory (``True`` = the default location) so repeat runs —
+        and, on shared storage, every host of a fleet — skip the slow
+        first compile; the fused-window AOT programs land in it too
+        (see :func:`enable_compile_cache`; TPU only — a warning names
+        why elsewhere). ``None`` defers to
+        ``FLUXMPI_TPU_COMPILE_CACHE``.
 
     Returns:
       The global :class:`jax.sharding.Mesh`.
@@ -344,6 +426,7 @@ def init(
         _compileplane.configure(compileplane)
         _memory.configure(memory)
         _profiling.configure_auto_profiler(profile)
+        _configure_compile_cache(compile_cache)
         if verbose:
             fluxmpi_println("fluxmpi_tpu already initialized; skipping...")
         assert _state.mesh is not None
@@ -403,6 +486,7 @@ def init(
     _compileplane.configure(compileplane)
     _memory.configure(memory)
     _profiling.configure_auto_profiler(profile)
+    _configure_compile_cache(compile_cache)
 
     if verbose:
         if total_workers() == 1:

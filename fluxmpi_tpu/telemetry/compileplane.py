@@ -102,6 +102,11 @@ class CompileMonitor:
         self._seconds: dict[str, float] = {p: 0.0 for p in ("trace", "lower", "compile")}
         self._tracked: dict[str, Any] = {}
         self._cache_sizes: dict[str, int] = {}
+        # AOT-lowered programs have no growing jit cache to poll:
+        # attribution comes from explicit note_aot_compile() calls
+        # (name -> [compile count, compile seconds, count at last poll,
+        # seconds at last flush]). Driver-thread only, like _tracked.
+        self._aot: dict[str, list[float]] = {}
         self._steady = False
         # observe_flush delta baselines.
         self._flushed_events = 0
@@ -157,6 +162,25 @@ class CompileMonitor:
         self._tracked[name] = fn
         self._cache_sizes[name] = self._cache_size(fn)
 
+    def track_aot(self, name: str) -> None:
+        """Register an AOT-lowered program under ``name``. AOT
+        executables (``jit(...).lower().compile()``) never grow a jit
+        cache, so attribution counts explicit :meth:`note_aot_compile`
+        calls instead of cache polls — the executable-handle path that
+        lets ``compile.function_seconds{<name>}`` appear and steady-state
+        retrace detection cover fused-window programs."""
+        self._aot.setdefault(name, [0, 0.0, 0, 0.0])
+
+    def note_aot_compile(self, name: str, seconds: float = 0.0) -> None:
+        """Record one AOT lower+compile of the tracked program ``name``
+        (``seconds`` = caller-measured wall time of the
+        ``lower().compile()`` pair). After the warmup boundary this
+        counts as a retrace of ``name`` at the next flush, exactly like
+        jit-cache growth does for live-jit functions."""
+        entry = self._aot.setdefault(name, [0, 0.0, 0, 0.0])
+        entry[0] += 1
+        entry[1] += float(seconds)
+
     def mark_steady(self) -> None:
         """Declare warmup over: any compile event from here on is a
         steady-state retrace. ``observe_flush`` does this implicitly
@@ -184,7 +208,8 @@ class CompileMonitor:
     def _growers(self) -> dict[str, int]:
         """Tracked functions whose jit caches grew since the last poll,
         mapped to HOW MANY entries they grew by (the per-function
-        retrace count for the interval)."""
+        retrace count for the interval). AOT-tracked programs count
+        their explicit :meth:`note_aot_compile` calls the same way."""
         grown: dict[str, int] = {}
         for name, fn in self._tracked.items():
             size = self._cache_size(fn)
@@ -192,6 +217,10 @@ class CompileMonitor:
             if size > base >= 0:
                 grown[name] = size - base
             self._cache_sizes[name] = size
+        for name, entry in self._aot.items():
+            if entry[0] > entry[2]:
+                grown[name] = int(entry[0] - entry[2])
+            entry[2] = entry[0]
         return grown
 
     def observe_flush(
@@ -230,6 +259,15 @@ class CompileMonitor:
         self._flushed_seconds = seconds
         delta_total = sum(delta_seconds.values())
         growers = self._growers()
+        # AOT compile-seconds deltas advance with the flush baselines
+        # above (registry-enabled or not), so a disabled interval never
+        # re-reports its seconds later.
+        aot_seconds: dict[str, float] = {}
+        for name, entry in self._aot.items():
+            d = entry[1] - entry[3]
+            entry[3] = entry[1]
+            if d > 0:
+                aot_seconds[name] = d
         functions = list(growers)
         if delta_events and not functions:
             functions = [UNTRACKED]
@@ -258,6 +296,16 @@ class CompileMonitor:
                         reg.counter("compile.retraces", function=name).inc(
                             growers.get(name, delta_events)
                         )
+            for name, entry in self._aot.items():
+                aot_delta = growers.get(name, 0)
+                if aot_delta:
+                    reg.counter(
+                        "compile.aot_programs", function=name
+                    ).inc(aot_delta)
+                if aot_seconds.get(name, 0.0) > 0:
+                    reg.counter(
+                        "compile.aot_seconds", function=name
+                    ).inc(aot_seconds[name])
             if goodput_tracker is not None and getattr(
                 goodput_tracker, "enabled", False
             ):

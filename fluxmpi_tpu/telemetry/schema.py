@@ -105,6 +105,16 @@ KNOWN_METRIC_NAMES = frozenset(
         "compile.function_seconds",
         "compile.retraces",
         "compile.unattributed_seconds",
+        # Fused-window path (PR 11): AOT-lowered programs have no jit
+        # cache to poll — explicit lower()+compile() accounting, labeled
+        # {function=...} like the live-jit attribution above.
+        "compile.aot_programs",
+        "compile.aot_seconds",
+        # train_loop fuse="window": the window width in optimizer
+        # updates and the cumulative one-dispatch-per-window count (the
+        # fused path's host-cost contract, directly observable).
+        "train.window.size",
+        "train.window.dispatches",
         "memory.bytes_in_use",
         "memory.peak_bytes_in_use",
         "memory.bytes_limit",
@@ -177,6 +187,11 @@ _BENCH_OPTIONAL: dict[str, tuple[type, ...]] = {
     # clock or FLOPs estimate). Recorded instead of stderr-only printed
     # so trajectory tooling can see the discard happened.
     "mfu_discarded": (bool,),
+    # Fused-window A/B (PR 11): per-leg throughput + dispatches-per-
+    # update for the pipelined vs fuse="window" train_loop paths, so the
+    # one-dispatch-per-window claim is asserted in the record rather
+    # than inferred.
+    "fused_window": (dict,),
 }
 
 

@@ -17,7 +17,13 @@ from __future__ import annotations
 
 from typing import Any
 
-__all__ = ["chip_peak_flops", "cost_analysis_flops", "mfu", "PEAK_FLOPS"]
+__all__ = [
+    "chip_peak_flops",
+    "cost_analysis_flops",
+    "executable_flops",
+    "mfu",
+    "PEAK_FLOPS",
+]
 
 # Peak bf16 FLOPs/s per chip by device_kind substring (public spec
 # sheets). Ordered: first substring match wins, so the more specific
@@ -43,14 +49,12 @@ def chip_peak_flops(device_kind: str) -> float | None:
     return None
 
 
-def cost_analysis_flops(step: Any, state: Any, data: Any) -> float | None:
-    """FLOPs per compiled step call straight from XLA's cost model, if
-    exposed. ``step`` is anything with a ``.lower(state, data)`` (a
-    ``jax.jit`` wrapper or a :func:`~fluxmpi_tpu.parallel.make_train_step`
-    product); lowering does not execute or consume donated buffers, so
-    it is safe to call on the live pre-first-dispatch state."""
+def executable_flops(compiled: Any) -> float | None:
+    """FLOPs per call of an ALREADY-compiled executable (the product of
+    ``jit(...).lower().compile()``) from XLA's cost model, if exposed —
+    the AOT twin of :func:`cost_analysis_flops`, used by the fused-window
+    path which compiles its program once up front."""
     try:
-        compiled = step.lower(state, data).compile()
         analysis = compiled.cost_analysis()
         if isinstance(analysis, (list, tuple)):
             analysis = analysis[0] if analysis else None
@@ -60,6 +64,18 @@ def cost_analysis_flops(step: Any, state: Any, data: Any) -> float | None:
     except Exception:
         pass
     return None
+
+
+def cost_analysis_flops(step: Any, state: Any, data: Any) -> float | None:
+    """FLOPs per compiled step call straight from XLA's cost model, if
+    exposed. ``step`` is anything with a ``.lower(state, data)`` (a
+    ``jax.jit`` wrapper or a :func:`~fluxmpi_tpu.parallel.make_train_step`
+    product); lowering does not execute or consume donated buffers, so
+    it is safe to call on the live pre-first-dispatch state."""
+    try:
+        return executable_flops(step.lower(state, data).compile())
+    except Exception:
+        return None
 
 
 def mfu(

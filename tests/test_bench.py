@@ -195,6 +195,29 @@ def test_leg_breakdown_lifts_diagnostics():
     assert bench._leg_breakdown({"value": 5.0}) == {"synthetic": 5.0}
 
 
+def test_leg_breakdown_lifts_fused_window():
+    rec = {
+        "value": 100.0,
+        "fused_window": {
+            "window": 8,
+            "pipelined": {"samples_per_sec_per_chip": 4000.0,
+                          "dispatches_per_update": 1.0},
+            "fused": {"samples_per_sec_per_chip": 20000.0,
+                      "dispatches_per_update": 0.125},
+            "dispatch_reduction": 8.0,
+            "speedup": 5.0,
+        },
+    }
+    out = bench._leg_breakdown(rec)
+    assert out["fused_window"] == {
+        "window": 8,
+        "pipelined_dispatches_per_update": 1.0,
+        "fused_dispatches_per_update": 0.125,
+        "dispatch_reduction": 8.0,
+        "speedup": 5.0,
+    }
+
+
 def test_run_scaling_includes_breakdown(monkeypatch):
     def fake_run_child(config, timeout, platform, extra_env=None):
         n = extra_env.get("FLUXMPI_TPU_BENCH_DEVICES", "1")
@@ -255,6 +278,16 @@ def test_bench_smoke_mode_emits_schema_valid_json(tmp_path):
     )
     assert result.get("smoke") == 1
     assert "dispatch" in result
+    # Fused-window leg (PR 11): the one-dispatch-per-window claim is
+    # asserted in the record itself — dispatches per update reduced >=5x
+    # vs the pipelined path.
+    fused = result.get("fused_window")
+    assert fused, "mlp child must carry the fused A/B leg"
+    assert fused["fused"]["dispatches_per_update"] == pytest.approx(
+        1.0 / fused["window"]
+    )
+    assert fused["pipelined"]["dispatches_per_update"] == 1.0
+    assert fused["dispatch_reduction"] >= 5.0
     json_path = tmp_path / "smoke.json"
     json_path.write_text(json.dumps(result))
     check = subprocess.run(

@@ -126,7 +126,10 @@ def test_spmd_mutation_of_train_loop_fails_the_lint():
         mutated, "fluxmpi_tpu/parallel/loop.py", ctx,
         rules=[SpmdDivergentCollective()],
     )
-    assert "train_loop:host_allreduce:shortcircuit" in _keys(
+    # The coordination collective lives in train_loop's _post_dispatch
+    # closure (the shared pipelined/fused boundary hook) — the key names
+    # the innermost function, the prefix anchors it to train_loop.
+    assert "train_loop._post_dispatch:host_allreduce:shortcircuit" in _keys(
         bad, "spmd-divergent-collective"
     )
 
