@@ -33,7 +33,9 @@ site                  where it fires
 ====================  =====================================================
 
 A firing site raises :class:`FaultInjectedError` (re-exported from
-:mod:`fluxmpi_tpu.errors`), bumps the ``fault.injected`` counter
+:mod:`fluxmpi_tpu.errors`) — or, for a ``delay=`` entry, sleeps that
+many seconds in place and continues (a *stall*, not a crash: the chaos
+producer for the liveness planes) — bumps the ``fault.injected`` counter
 (labeled by site) in the default telemetry registry, and lands a
 ``fault.injected`` instant on the trace timeline when tracing is on.
 
@@ -60,10 +62,16 @@ startup. User code weaving its own sites declares them with
              times  cap on total injections for this entry (default 1 for
                     step/bare entries, unlimited for ``p`` entries)
              proc   only fire on this controller-process index
+             delay  inject a STALL instead of a crash: the firing site
+                    sleeps ``delay`` seconds and then continues (no
+                    exception) — the chaos producer for everything that
+                    watches liveness (the hang watchdog, the data-stall
+                    anomaly rule, the live exporter's ``/healthz``)
 
 Examples: ``comm.allreduce@step=7`` (the 7th allreduce raises, once),
 ``ckpt.write:p=0.1:seed=0`` (each write attempt fails with p=0.1),
-``data.fetch@step=5:times=2:proc=1`` (process 1's 5th and 6th fetches).
+``data.fetch@step=5:times=2:proc=1`` (process 1's 5th and 6th fetches),
+``data.fetch@step=30:delay=0.5`` (the 30th fetch stalls half a second).
 
 **Determinism**: every site keeps a monotonic hit counter; ``step``
 entries key off it, ``p`` entries draw one value from a seeded
@@ -195,6 +203,7 @@ class FaultSpec:
         seed: int = 0,
         times: int | None = None,
         proc: int | None = None,
+        delay: float | None = None,
     ):
         if not site or not isinstance(site, str):
             raise ValueError(f"fault site must be a non-empty string, got {site!r}")
@@ -206,10 +215,13 @@ class FaultSpec:
             raise ValueError("step= and p= are mutually exclusive triggers")
         if times is not None and times < 1:
             raise ValueError(f"times must be >= 1, got {times}")
+        if delay is not None and delay <= 0:
+            raise ValueError(f"delay must be > 0 seconds, got {delay}")
         self.site = site
         self.step = step
         self.p = p
         self.seed = int(seed)
+        self.delay = float(delay) if delay is not None else None
         # Bare/step entries default to a single injection (a "crash");
         # probability entries default to unlimited (a flaky medium).
         self.times = times if times is not None else (None if p is not None else 1)
@@ -243,6 +255,8 @@ class FaultSpec:
             parts.append(f"times={self.times}")
         if self.proc is not None:
             parts.append(f"proc={self.proc}")
+        if self.delay is not None:
+            parts.append(f"delay={self.delay:g}")
         return ":".join(parts)
 
     __repr__ = __str__
@@ -266,12 +280,12 @@ def parse_spec(entry: str) -> FaultSpec:
         key = key.strip()
         if key in ("step", "times", "proc", "seed"):
             kwargs[key] = int(value)
-        elif key == "p":
+        elif key in ("p", "delay"):
             kwargs[key] = float(value)
         else:
             raise ValueError(
                 f"unknown fault modifier {key!r} in {entry!r}; expected one "
-                f"of step/p/seed/times/proc"
+                f"of step/p/seed/times/proc/delay"
             )
     return FaultSpec(site.strip(), **kwargs)
 
@@ -407,8 +421,12 @@ def _record(site: str, hit: int, spec: FaultSpec) -> None:
 
 
 def check(site: str) -> None:
-    """Count a hit at ``site`` and raise :class:`FaultInjectedError` when
-    a spec fires. Call sites MUST guard with ``if faults.ARMED:`` — this
+    """Count a hit at ``site`` and, when a spec fires, raise
+    :class:`FaultInjectedError` — or, for a ``delay=`` spec, **stall**
+    the caller that many seconds and continue (the liveness-chaos
+    producer: the site slows down exactly where a real stall would, so
+    the watchdog / data-stall rule / ``/healthz`` see the honest
+    signal). Call sites MUST guard with ``if faults.ARMED:`` — this
     function is never on a fully-off hot path."""
     sched = _active
     if sched is None:
@@ -420,6 +438,11 @@ def check(site: str) -> None:
             spec.injected += 1
             sched.injected += 1
             _record(site, hit, spec)
+            if spec.delay is not None:
+                import time
+
+                time.sleep(spec.delay)
+                continue  # a stall is not a crash: later specs still run
             raise FaultInjectedError(site, hit, str(spec))
 
 

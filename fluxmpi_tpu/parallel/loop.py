@@ -401,6 +401,14 @@ def train_loop(
     bundle on disk. Fully disabled (the default), neither plane adds
     perf_counter reads or registry lookups to the hot loop.
 
+    Live export: with the exporter serving (``init(export=...)`` /
+    ``FLUXMPI_TPU_EXPORT_PORT``) the loop posts its status board —
+    run config at start, updates/loss/step-time per flush, the outcome
+    at exit — to the ``/status`` endpoint, and ``/metrics`` scrapes see
+    every flush's registry state live (see docs/observability.md,
+    "Live export"). Off (the default), the loop reads one module
+    attribute per run and never touches the exporter.
+
     Returns:
       ``(final_state, summary)`` — summary has ``updates``, ``epochs``,
       ``examples``, ``seconds``, ``updates_per_sec``,
@@ -473,6 +481,7 @@ def train_loop(
     from ..telemetry import get_registry
     from ..telemetry import anomaly as _anomaly
     from ..telemetry import compileplane as _compileplane
+    from ..telemetry import export as _export
     from ..telemetry import goodput as _goodput
     from .train import _DEFAULT_REGISTRY
 
@@ -490,6 +499,13 @@ def train_loop(
     det_on = detector is not None and detector.enabled
     cp = _compileplane.get_compile_monitor()
     cp_on = cp is not None and cp.enabled
+    # Live export plane: when an exporter is serving, the loop posts its
+    # status board at flush boundaries (run config at start, counters /
+    # loss per flush, outcome at exit) — a dict update under a lock, no
+    # device syncs, nothing per step. Off (the default) the loop never
+    # calls note_status (monkeypatch-explode tested).
+    exporter = _export.get_exporter()
+    exp_on = exporter is not None and exporter.enabled
     if cp_on:
         # Tag the hot step for retrace attribution: its jit-cache growth
         # after the warmup boundary names it in the steady_state_retrace
@@ -743,6 +759,24 @@ def train_loop(
 
     last_saved = updates
     preempted = False
+    if exp_on:
+        # Run config + resume position, posted once the resume block has
+        # settled them (fused_w can still fall back during an elastic
+        # resume above).
+        exporter.note_status(
+            phase="running",
+            updates=updates,
+            examples=examples,
+            epochs=epochs_done,
+            steps_budget=steps,
+            epochs_budget=epochs,
+            flush_every=flush_every,
+            scan_steps=k,
+            fused_window=fused_w or None,
+            resumed_from=resumed_from,
+            preempted=False,
+            anomaly=None,
+        )
 
     def _save_ckpt(pass_counted: bool = False) -> None:
         nonlocal last_saved
@@ -903,7 +937,7 @@ def train_loop(
         loss_v: float | None = None
         grad_v: float | None = None
         window_stats: dict[str, float] = {}
-        if record_metrics or det_on:
+        if record_metrics or det_on or exp_on:
             if fused_w:
                 # The window program's metric carry: a dict of f32
                 # scalars — ONE tiny device→host transfer per flush.
@@ -1000,6 +1034,21 @@ def train_loop(
             for ev in events:
                 if ev["action"] == "halt" and halt_rule is None:
                     halt_rule = ev["rule"]
+        if exp_on:
+            # /status stays current between JSONL flushes: the numbers
+            # this flush just drained, posted to the live status board.
+            exporter.note_status(
+                updates=updates,
+                examples=examples,
+                epochs=epochs_done,
+                loss=loss_v,
+                grad_norm=grad_v,
+                step_seconds=per_update,
+                examples_per_sec=(
+                    interval_examples / elapsed if elapsed > 0 else 0.0
+                ),
+                dispatches=dispatches,
+            )
         interval_updates = 0
         interval_examples = 0
         interval_windows = 0
@@ -1240,4 +1289,22 @@ def train_loop(
         # callers get the breakdown without touching the registry.
         gp.record(_live_registry() if record_metrics else None)
         summary["goodput"] = gp.report()
+    if exp_on:
+        # Terminal status: /status keeps answering after the loop exits
+        # (an operator asking "why did it stop" gets the outcome, not a
+        # stale "running").
+        exporter.note_status(
+            phase=(
+                "preempted"
+                if preempted
+                else ("halted" if halt_rule else "finished")
+            ),
+            updates=updates,
+            examples=examples,
+            epochs=epochs_done,
+            loss=loss,
+            preempted=preempted,
+            anomaly=halt_rule,
+            dispatches=dispatches,
+        )
     return state, summary

@@ -305,6 +305,7 @@ def init(
     memory: Any = None,
     profile: Any = None,
     compile_cache: Any = None,
+    export: Any = None,
 ) -> Mesh:
     """Bring up the fluxmpi_tpu runtime. Idempotent.
 
@@ -400,6 +401,17 @@ def init(
         (see :func:`enable_compile_cache`; TPU only — a warning names
         why elsewhere). ``None`` defers to
         ``FLUXMPI_TPU_COMPILE_CACHE``.
+      export: start the live export plane — an in-process HTTP server
+        (stdlib, daemon thread) serving Prometheus ``/metrics``, a
+        ``/status`` JSON snapshot, and a ``/healthz`` liveness probe
+        keyed to the watchdog's progress clock (503 when progress
+        stalls past the deadline — orchestrator-restartable). ``True``
+        serves on the default port (9307), a port number on that port,
+        or pass an :class:`~fluxmpi_tpu.telemetry.Exporter`; ``None``
+        defers to ``FLUXMPI_TPU_EXPORT_PORT`` (bind address from
+        ``FLUXMPI_TPU_EXPORT_ADDR``). Poll a fleet with
+        ``scripts/fluxmpi_top.py``; see docs/observability.md
+        "Live export".
 
     Returns:
       The global :class:`jax.sharding.Mesh`.
@@ -408,6 +420,7 @@ def init(
     from .telemetry import anomaly as _anomaly
     from .telemetry import compileplane as _compileplane
     from .telemetry import configure as _configure_telemetry
+    from .telemetry import export as _export
     from .telemetry import goodput as _goodput
     from .telemetry import memory as _memory
     from .telemetry import tracing as _tracing
@@ -427,6 +440,7 @@ def init(
         _memory.configure(memory)
         _profiling.configure_auto_profiler(profile)
         _configure_compile_cache(compile_cache)
+        _export.configure(export)
         if verbose:
             fluxmpi_println("fluxmpi_tpu already initialized; skipping...")
         assert _state.mesh is not None
@@ -487,6 +501,7 @@ def init(
     _memory.configure(memory)
     _profiling.configure_auto_profiler(profile)
     _configure_compile_cache(compile_cache)
+    _export.configure(export)
 
     if verbose:
         if total_workers() == 1:

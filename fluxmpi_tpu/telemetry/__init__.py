@@ -147,6 +147,12 @@ from .compileplane import (  # noqa: F401
     set_compile_monitor,
 )
 from . import memory  # noqa: F401
+from . import export  # noqa: F401
+from .export import (  # noqa: F401
+    Exporter,
+    get_exporter,
+    set_exporter,
+)
 
 __all__ = [
     "Counter",
@@ -193,6 +199,9 @@ __all__ = [
     "CompileMonitor",
     "get_compile_monitor",
     "set_compile_monitor",
+    "Exporter",
+    "get_exporter",
+    "set_exporter",
     "configure",
     "shutdown",
 ]
@@ -256,19 +265,36 @@ def configure(spec: Any = None) -> MetricsRegistry:
 
 
 def shutdown() -> None:
-    """Tear down the observability planes in failure-safe order: disarm
-    the watchdog, export the trace ring (when a path was configured),
-    reset the run-health plane (goodput window + anomaly detector) and
-    the device plane (compile monitor, HBM watermark, auto-profiler —
-    state left armed would leak into the next init cycle), then flush
-    and detach every sink on the default registry (instruments survive —
-    a re-configured registry keeps its cumulative counters)."""
+    """Tear down the observability planes in failure-safe order: stop
+    the live exporter FIRST (socket closed, serving thread joined — the
+    port is immediately rebindable, and no scrape ever observes a
+    half-reset process), disarm the watchdog, export the trace ring
+    (when a path was configured) then reset the tracer and the flight
+    recorder ring, reset the run-health plane (goodput window + anomaly
+    detector) and the device plane (compile monitor, HBM watermark,
+    auto-profiler — state left armed would leak into the next init
+    cycle), then flush and detach every sink on the default registry
+    (instruments survive — a re-configured registry keeps its cumulative
+    counters)."""
+    try:
+        export.shutdown()
+    except Exception:
+        pass
     try:
         disarm_watchdog()
     except Exception:
         pass
     try:
         tracing.shutdown()
+    except Exception:
+        pass
+    try:
+        # AFTER the export above: reset drops the ring the export just
+        # saved. The flight recorder keeps its cumulative counters
+        # (comm deltas stay monotonic) but drops the entries — run 1's
+        # launches must not appear in run 2's hang dumps.
+        tracing.reset()
+        get_flight_recorder().clear()
     except Exception:
         pass
     try:

@@ -57,6 +57,12 @@ TRACE_SCHEMA = "fluxmpi_tpu.trace/v1"
 
 MANIFEST_SCHEMA = "fluxmpi_tpu.manifest/v1"
 
+# The /status endpoint of the live export plane (telemetry/export.py):
+# one JSON snapshot per scrape — run identity, the train_loop status
+# board, a live goodput breakdown, the last anomaly, monitor gauges,
+# and the health verdict. scripts/fluxmpi_top.py polls it fleet-wide.
+STATUS_SCHEMA = "fluxmpi_tpu.status/v1"
+
 METRIC_TYPES = ("counter", "gauge", "histogram")
 
 _HIST_STAT_KEYS = ("sum", "min", "max", "mean", "last")
@@ -134,6 +140,13 @@ KNOWN_METRIC_NAMES = frozenset(
         "monitor.goodput_fraction_max",
         "monitor.goodput_fraction_mean",
         "host.memory.peak_rss_bytes",
+        # Live export plane (PR 12): the exporter's self-telemetry —
+        # scrape counts per endpoint ({endpoint=metrics|status|healthz})
+        # and the last /metrics render cost (set AFTER the render, so it
+        # describes the previous scrape — measuring a render from inside
+        # itself would lie).
+        "export.requests",
+        "export.render_seconds",
     }
 )
 
@@ -144,6 +157,7 @@ _CLOSED_NAMESPACES = (
     "anomaly.",
     "compile.",
     "memory.",
+    "export.",
 )
 
 # The preemption trace event train_loop emits when it drains and exits on
@@ -291,6 +305,48 @@ def validate_bench_record(rec: object) -> list[str]:
             )
     if "mfu" in rec and _is_number(rec["mfu"]) and not 0 <= rec["mfu"] <= 1:
         errors.append(f"'mfu' out of range [0, 1]: {rec['mfu']!r}")
+    return errors
+
+
+def validate_status_record(rec: object) -> list[str]:
+    """Validate one live-export ``/status`` snapshot (schema
+    "fluxmpi_tpu.status/v1", produced by
+    ``telemetry/export.Exporter.build_status`` and consumed by
+    ``scripts/fluxmpi_top.py``); returns a list of error strings."""
+    if not isinstance(rec, dict):
+        return [f"status record is not an object: {type(rec).__name__}"]
+    errors: list[str] = []
+    if rec.get("schema") != STATUS_SCHEMA:
+        errors.append(
+            f"'schema' must be {STATUS_SCHEMA!r}, got {rec.get('schema')!r}"
+        )
+    if not _is_number(rec.get("time_unix")):
+        errors.append("missing numeric 'time_unix'")
+    proc = rec.get("process")
+    if not isinstance(proc, int) or isinstance(proc, bool) or proc < 0:
+        errors.append("'process' must be an int >= 0")
+    if not isinstance(rec.get("run_id"), str) or not rec.get("run_id"):
+        errors.append("missing/invalid 'run_id' (str)")
+    pc = rec.get("process_count")
+    if not isinstance(pc, int) or isinstance(pc, bool) or pc < 1:
+        errors.append("'process_count' must be an int >= 1")
+    for key in ("train", "monitor", "watchdog"):
+        if not isinstance(rec.get(key), dict):
+            errors.append(f"'{key}' must be an object")
+    for key in ("goodput", "anomaly"):
+        v = rec.get(key)
+        if v is not None and not isinstance(v, dict):
+            errors.append(f"'{key}' must be null or an object")
+    health = rec.get("health")
+    if not isinstance(health, dict):
+        errors.append("'health' must be an object")
+    else:
+        if not isinstance(health.get("healthy"), bool):
+            errors.append("health: 'healthy' must be a bool")
+        if not _is_number(health.get("seconds_since_progress")):
+            errors.append("health: missing numeric 'seconds_since_progress'")
+        if not _is_number(health.get("deadline_seconds")):
+            errors.append("health: missing numeric 'deadline_seconds'")
     return errors
 
 

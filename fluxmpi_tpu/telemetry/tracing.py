@@ -52,6 +52,7 @@ __all__ = [
     "trace_enabled",
     "configure",
     "shutdown",
+    "reset",
 ]
 
 _ENV_VAR = "FLUXMPI_TPU_TRACE"
@@ -348,10 +349,24 @@ def configure(spec: Any = None) -> Tracer:
 def shutdown() -> str | None:
     """Export the default tracer to the configured path (if any) and
     return the written path. Recording state is left as-is — shutdown
-    is about not losing the ring, not about disabling."""
+    is about not losing the ring, not about disabling; the full
+    teardown (``telemetry.shutdown()``) calls :func:`reset` after."""
     if _export_path is None or not len(_default):
         return None
     # export() owns the one-and-only {process} formatting — formatting
     # here too would re-format the result and break escaped braces.
     _default.export(_export_path)
     return _export_path.format(process=_process_index())
+
+
+def reset() -> None:
+    """Disable recording and drop the default tracer's ring, open-span
+    stacks, and pending export path — called by ``telemetry.shutdown()``
+    AFTER :func:`shutdown` exported the ring (the fault-plane leak rule:
+    a tracer left recording, or run 1's events still in the ring, would
+    leak into the next init cycle's exports and hang dumps)."""
+    global _export_path
+    _default.enabled = False
+    _default.clear()
+    _default._open.clear()
+    _export_path = None

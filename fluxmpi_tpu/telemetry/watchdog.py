@@ -53,6 +53,7 @@ __all__ = [
     "disarm_watchdog",
     "get_watchdog",
     "notify_progress",
+    "progress_value",
     "configure",
 ]
 
@@ -72,8 +73,15 @@ def notify_progress(n: int = 1) -> None:
     _progress += n
 
 
-def _progress_value() -> int:
+def progress_value() -> int:
+    """Current value of the module progress counter — the read half of
+    :func:`notify_progress`. The watchdog polls it on its thread; the
+    live exporter's ``/healthz`` (telemetry/export.py) reads it per
+    request — one liveness clock, two consumers."""
     return _progress
+
+
+_progress_value = progress_value  # internal alias (default sources list)
 
 
 class Watchdog:

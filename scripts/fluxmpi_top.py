@@ -1,0 +1,326 @@
+#!/usr/bin/env python
+"""fluxmpi_top: a refreshing terminal view of a running fleet.
+
+Polls the live export plane's ``/status`` endpoint
+(``init(export=...)`` / ``FLUXMPI_TPU_EXPORT_PORT``, see
+docs/observability.md "Live export") across a host list and renders one
+row per host — step count and live step rate, loss, goodput fraction
+and MFU, heartbeat age, straggler flag, health verdict — plus an
+anomaly ticker of the most recent triggers fleet-wide:
+
+    $ python scripts/fluxmpi_top.py tpu-host-0 tpu-host-1:9307
+    fluxmpi_top  2 host(s)  13:37:02  run 6a71-1919  phase running
+    HOST             STEP     UP/S    LOSS  GOODPUT    MFU  HB AGE  HEALTH
+    tpu-host-0       9600     81.2  0.0312    91.2%  0.412    2.1s  ok
+    tpu-host-1       9600     80.9  0.0312    90.8%  0.409    2.3s  ok
+    anomalies: (none)
+
+Targets are ``host``, ``host:port`` (default port 9307), or full URLs.
+``--jsonl FILE...`` is the fallback for runs without an exporter: the
+same view re-derived from the growing telemetry JSONL bank (last record
+per process; heartbeat age from ``monitor.heartbeat_unix``) — health
+then reads ``jsonl`` because there is no live probe to ask.
+
+Usage:
+    python scripts/fluxmpi_top.py HOST [HOST ...] [--interval N]
+    python scripts/fluxmpi_top.py --jsonl run.*.jsonl [--interval N]
+    python scripts/fluxmpi_top.py HOST --once [--json]
+
+``--once`` renders a single frame and exits (scripting/tests); ``--json``
+prints the raw per-host status objects as one JSON line instead of the
+table. Exit codes (``--once``): 0 = at least one host reported;
+2 = nothing reachable/readable.
+
+Stdlib-only, no jax, no package import — runnable from a laptop against
+a pod (the ``goodput_report.py`` / ``check_metrics_schema.py``
+contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any
+
+DEFAULT_PORT = 9307  # telemetry/export.py DEFAULT_PORT (kept in sync)
+
+_CLEAR = "\x1b[2J\x1b[H"
+
+
+def _base_url(target: str) -> str:
+    if target.startswith(("http://", "https://")):
+        return target.rstrip("/")
+    if ":" not in target:
+        target = f"{target}:{DEFAULT_PORT}"
+    return f"http://{target}"
+
+
+def fetch_status(target: str, timeout: float = 2.0) -> dict[str, Any] | None:
+    """One host's ``/status`` snapshot, or None when unreachable/bad."""
+    try:
+        with urllib.request.urlopen(
+            _base_url(target) + "/status", timeout=timeout
+        ) as resp:
+            rec = json.load(resp)
+    except (urllib.error.URLError, OSError, ValueError):
+        return None
+    return rec if isinstance(rec, dict) else None
+
+
+# ---------------------------------------------------------------------------
+# JSONL fallback: the same row, re-derived from the metrics bank.
+# ---------------------------------------------------------------------------
+
+
+def _jsonl_statuses(paths: list[str]) -> dict[str, dict[str, Any]]:
+    """Last flush record per process across the JSONL files, reshaped
+    into /status-like objects (the subset the table renders). Torn lines
+    are skipped — the bank is being written while we read it."""
+    per_process: dict[int, dict[str, Any]] = {}
+    for path in paths:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                content = f.read()
+        except OSError:
+            continue
+        for line in content.splitlines():
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn mid-write line: expected on a live bank
+            if not isinstance(rec, dict) or not isinstance(
+                rec.get("metrics"), list
+            ):
+                continue
+            proc = rec.get("process")
+            per_process[proc if isinstance(proc, int) else 0] = rec
+    out: dict[str, dict[str, Any]] = {}
+    for proc in sorted(per_process):
+        rec = per_process[proc]
+        flat: dict[str, float] = {}
+        buckets: dict[str, float] = {}
+        for m in rec["metrics"]:
+            if not isinstance(m, dict) or "value" not in m:
+                continue
+            name = m.get("name")
+            if name == "goodput.bucket_seconds":
+                bucket = (m.get("labels") or {}).get("bucket")
+                if isinstance(bucket, str):
+                    buckets[bucket] = float(m["value"])
+            elif isinstance(name, str) and not m.get("labels"):
+                flat[name] = float(m["value"])
+        goodput = None
+        if "goodput.wall_seconds" in flat:
+            goodput = {
+                "wall_seconds": flat["goodput.wall_seconds"],
+                "goodput_fraction": flat.get("goodput.fraction", 0.0),
+                "updates": int(flat.get("goodput.updates", 0)),
+                "mfu": flat.get("goodput.mfu"),
+                "buckets": buckets,
+            }
+        hb_unix = flat.get("monitor.heartbeat_unix")
+        monitor: dict[str, float] = {
+            name[len("monitor."):]: value
+            for name, value in flat.items()
+            if name.startswith("monitor.")
+        }
+        out[f"proc{proc}"] = {
+            "process": proc,
+            "time_unix": rec.get("time_unix"),
+            "train": {
+                "updates": int(flat.get("train.steps", 0)),
+                "loss": flat.get("train.loss"),
+                "examples_per_sec": flat.get("train.examples_per_sec"),
+            },
+            "goodput": goodput,
+            "anomaly": None,
+            "monitor": monitor,
+            "health": {"healthy": None, "source": "jsonl"},
+            "heartbeat_age_override": (
+                time.time() - hb_unix if hb_unix else None
+            ),
+        }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Rendering
+# ---------------------------------------------------------------------------
+
+
+def _fmt(value: Any, spec: str, dash: str = "-") -> str:
+    if value is None:
+        return dash
+    try:
+        return format(value, spec)
+    except (TypeError, ValueError):
+        return dash
+
+
+def _row(
+    name: str,
+    status: dict[str, Any] | None,
+    rates: dict[str, tuple[float, float]],
+) -> str:
+    if status is None:
+        return f"{name:<18} UNREACHABLE"
+    train = status.get("train") or {}
+    updates = train.get("updates")
+    # Live step rate from successive polls (cumulative counter delta);
+    # the first poll has no delta yet.
+    rate = None
+    now = time.time()
+    if isinstance(updates, (int, float)):
+        prev = rates.get(name)
+        if prev is not None and now > prev[0] and updates >= prev[1]:
+            rate = (updates - prev[1]) / (now - prev[0])
+        rates[name] = (now, float(updates))
+    gp = status.get("goodput") or {}
+    monitor = status.get("monitor") or {}
+    hb_age = status.get("heartbeat_age_override")
+    if hb_age is None:
+        hb_age = monitor.get("heartbeat_age_seconds")
+    health = status.get("health") or {}
+    healthy = health.get("healthy")
+    if healthy is None:
+        verdict = health.get("source", "?")
+    elif healthy:
+        verdict = "ok"
+    else:
+        verdict = "STALLED"
+    straggler = " *" if monitor.get("straggler") else ""
+    frac = gp.get("goodput_fraction")
+    return (
+        f"{name:<18}"
+        f"{_fmt(updates, '>8.0f'):>8} "
+        f"{_fmt(rate, '>7.1f'):>7} "
+        f"{_fmt(train.get('loss'), '>8.4g'):>8} "
+        f"{_fmt(100 * frac if frac is not None else None, '>7.1f'):>7}% "
+        f"{_fmt(gp.get('mfu'), '>6.3f'):>6} "
+        f"{_fmt(hb_age, '>6.1f'):>6}s "
+        f"{verdict}{straggler}"
+    )
+
+
+def render_frame(
+    statuses: dict[str, dict[str, Any] | None],
+    rates: dict[str, tuple[float, float]],
+) -> str:
+    """One dashboard frame (pure string — tests assert on it)."""
+    up = [s for s in statuses.values() if s]
+    run_ids = sorted({s.get("run_id", "?") for s in up if s.get("run_id")})
+    phases = sorted(
+        {
+            str((s.get("train") or {}).get("phase"))
+            for s in up
+            if (s.get("train") or {}).get("phase")
+        }
+    )
+    head = (
+        f"fluxmpi_top  {len(up)}/{len(statuses)} host(s)  "
+        f"{time.strftime('%H:%M:%S')}"
+    )
+    if run_ids:
+        head += f"  run {run_ids[0]}" + ("+" if len(run_ids) > 1 else "")
+    if phases:
+        head += f"  phase {','.join(phases)}"
+    lines = [
+        head,
+        f"{'HOST':<18}{'STEP':>8} {'UP/S':>7} {'LOSS':>8} "
+        f"{'GOODPUT':>8} {'MFU':>6} {'HB AGE':>7}  HEALTH",
+    ]
+    for name in statuses:
+        lines.append(_row(name, statuses[name], rates))
+    tickers: list[str] = []
+    for name, s in statuses.items():
+        ev = (s or {}).get("anomaly")
+        if isinstance(ev, dict) and ev.get("rule"):
+            tickers.append(
+                f"  {name}: {ev['rule']} "
+                f"(value {ev.get('value_repr', ev.get('value'))} "
+                f"at step {ev.get('step')})"
+            )
+    lines.append("anomalies:" + (" (none)" if not tickers else ""))
+    lines.extend(tickers)
+    return "\n".join(lines)
+
+
+def main(argv: list[str]) -> int:
+    parser = argparse.ArgumentParser(
+        description="Terminal dashboard over the live export plane "
+        "(/status across a host list, or a telemetry JSONL bank)."
+    )
+    parser.add_argument(
+        "targets", nargs="*",
+        help="hosts to poll: host, host:port (default port "
+        f"{DEFAULT_PORT}), or a full URL",
+    )
+    parser.add_argument(
+        "--jsonl", nargs="+", default=None, metavar="FILE",
+        help="fallback: derive the view from telemetry JSONL file(s) "
+        "instead of polling /status",
+    )
+    parser.add_argument(
+        "--interval", type=float, default=2.0,
+        help="seconds between refreshes (default 2)",
+    )
+    parser.add_argument(
+        "--timeout", type=float, default=2.0,
+        help="per-host HTTP timeout in seconds (default 2)",
+    )
+    parser.add_argument(
+        "--once", action="store_true",
+        help="render one frame and exit (scripting/tests)",
+    )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="print raw per-host status JSON instead of the table",
+    )
+    args = parser.parse_args(argv)
+    if bool(args.targets) == bool(args.jsonl):
+        parser.error("pass either host targets or --jsonl FILE..., not both")
+    if args.interval <= 0:
+        parser.error("--interval must be > 0")
+
+    rates: dict[str, tuple[float, float]] = {}
+    while True:
+        if args.jsonl:
+            statuses: dict[str, dict[str, Any] | None] = dict(
+                _jsonl_statuses(args.jsonl)
+            )
+            if not statuses:
+                statuses = {path: None for path in args.jsonl}
+        else:
+            statuses = {
+                t: fetch_status(t, timeout=args.timeout) for t in args.targets
+            }
+        if args.json:
+            print(
+                json.dumps(
+                    {name: statuses[name] for name in statuses}
+                )
+            )
+        else:
+            frame = render_frame(statuses, rates)
+            if not args.once:
+                sys.stdout.write(_CLEAR)
+            print(frame, flush=True)
+        if args.once:
+            return 0 if any(statuses.values()) else 2
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main(sys.argv[1:]))
+    except KeyboardInterrupt:
+        raise SystemExit(0)

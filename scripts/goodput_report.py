@@ -19,14 +19,22 @@ wall-clock attribution the fleet is managed on:
 
 Usage:
     python scripts/goodput_report.py FILE [FILE ...] [--json]
+    python scripts/goodput_report.py FILE [FILE ...] --watch N
 
 ``--json`` prints one machine-readable JSON object instead of the table.
 
-Exit codes: 0 = goodput data found and reported; 1 = inputs readable but
-NO goodput metrics anywhere (the plane was off — nothing to report);
-2 = a file was missing/unreadable. A torn or corrupt LINE (a host killed
-mid-write — the very post-mortem this report serves) is skipped with a
-stderr warning, never fatal.
+``--watch N`` turns the post-mortem report into a **mid-run monitor**:
+the report re-renders every N seconds from the growing JSONL bank (the
+same parse path — the gauges are cumulative, so the newest complete
+line per host is always the run total so far). In watch mode a missing
+file or a bank with no goodput data yet is a *waiting* state, not an
+error — the run may simply not have flushed — and Ctrl-C exits 0.
+
+Exit codes (one-shot mode): 0 = goodput data found and reported; 1 =
+inputs readable but NO goodput metrics anywhere (the plane was off —
+nothing to report); 2 = a file was missing/unreadable. A torn or
+corrupt LINE (a host killed mid-write — the very post-mortem this
+report serves) is skipped with a stderr warning, never fatal.
 
 Stdlib-only, no jax, no package import — runnable anywhere the JSONL
 landed (same contract as scripts/check_metrics_schema.py, which
@@ -169,16 +177,10 @@ def _print_host(host: dict) -> None:
         print(f"  {name:<20} {seconds:>9.2f}s  {share:>5.1f}%")
 
 
-def main(argv: list[str]) -> int:
-    parser = argparse.ArgumentParser(
-        description="Per-run goodput/badput breakdown from telemetry JSONL"
-    )
-    parser.add_argument("files", nargs="+", help="telemetry JSONL file(s)")
-    parser.add_argument(
-        "--json", action="store_true", help="machine-readable output"
-    )
-    args = parser.parse_args(argv)
-    per_process, errors = _read_streams(args.files)
+def _report_once(files: list[str], as_json: bool) -> int:
+    """One parse-and-render pass (the original one-shot behavior);
+    returns the process exit code."""
+    per_process, errors = _read_streams(files)
     for e in errors:
         print(e, file=sys.stderr)
     if errors:
@@ -186,13 +188,13 @@ def main(argv: list[str]) -> int:
     if not per_process:
         print(
             "goodput_report: no goodput.* metrics in "
-            f"{len(args.files)} file(s) — was the run started with "
+            f"{len(files)} file(s) — was the run started with "
             "FLUXMPI_TPU_GOODPUT=1 / init(goodput=True)?",
             file=sys.stderr,
         )
         return 1
     agg = _aggregate(per_process)
-    if args.json:
+    if as_json:
         print(json.dumps(agg))
         return 0
     for host in agg["hosts"]:
@@ -208,5 +210,83 @@ def main(argv: list[str]) -> int:
     return 0
 
 
+def _watch(files: list[str], interval: float, as_json: bool, count: int) -> int:
+    """Re-render every ``interval`` seconds from the growing bank.
+    Missing files / no-goodput-yet are waiting states here, not errors —
+    the run this monitors may not have flushed its first line yet.
+    ``count`` bounds the iterations (0 = until Ctrl-C; tests pass a
+    small count)."""
+    import time
+
+    iterations = 0
+    while True:
+        per_process, errors = _read_streams(files)
+        if not as_json:
+            # Redraw in place (ANSI clear), terminal-top style; JSON
+            # mode stays line-oriented for piping.
+            sys.stdout.write("\x1b[2J\x1b[H")
+        header = (
+            f"goodput_report --watch  {time.strftime('%H:%M:%S')}  "
+            f"({len(files)} file(s), refresh {interval:g}s)"
+        )
+        if as_json:
+            agg = _aggregate(per_process) if per_process else None
+            print(json.dumps({"time": time.time(), "report": agg}), flush=True)
+        else:
+            print(header)
+            for e in errors:
+                print(f"  waiting: {e}", file=sys.stderr)
+            if not per_process:
+                print("  (no goodput data yet — waiting for the first flush)")
+            else:
+                agg = _aggregate(per_process)
+                for host in agg["hosts"]:
+                    _print_host(host)
+                line = (
+                    f"run: {agg['host_count']} host stream(s)  "
+                    f"wall {agg['wall_seconds']:.1f}s  "
+                    f"goodput {100.0 * agg['goodput_fraction']:.1f}%"
+                )
+                if agg["mean_mfu"] is not None:
+                    line += f"  mean MFU {agg['mean_mfu']:.4f}"
+                print(line, flush=True)
+        iterations += 1
+        if count and iterations >= count:
+            return 0
+        try:
+            time.sleep(interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+def main(argv: list[str]) -> int:
+    parser = argparse.ArgumentParser(
+        description="Per-run goodput/badput breakdown from telemetry JSONL"
+    )
+    parser.add_argument("files", nargs="+", help="telemetry JSONL file(s)")
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    parser.add_argument(
+        "--watch", type=float, default=None, metavar="N",
+        help="re-render every N seconds from the growing bank (mid-run "
+        "monitoring; Ctrl-C exits 0)",
+    )
+    parser.add_argument(
+        "--watch-count", type=int, default=0, metavar="K",
+        help="stop after K watch renders (0 = until interrupted; "
+        "scripting/tests)",
+    )
+    args = parser.parse_args(argv)
+    if args.watch is not None:
+        if args.watch <= 0:
+            parser.error("--watch interval must be > 0")
+        return _watch(args.files, args.watch, args.json, args.watch_count)
+    return _report_once(args.files, args.json)
+
+
 if __name__ == "__main__":
-    raise SystemExit(main(sys.argv[1:]))
+    try:
+        raise SystemExit(main(sys.argv[1:]))
+    except KeyboardInterrupt:
+        raise SystemExit(0)

@@ -4,14 +4,29 @@
 Usage:
     python scripts/merge_traces.py -o merged.json trace.0.json trace.1.json ...
     python scripts/merge_traces.py -o merged.json 'traces/trace.*.json'
+    python scripts/merge_traces.py -o merged.json profiles/    # a logdir
 
-Each input is a ``fluxmpi_tpu.trace/v1`` / kind="trace" export (what
-``Tracer.export(path)`` / ``FLUXMPI_TPU_TRACE=<path>`` writes, one per
-host). Span timestamps are wall-clock-anchored microseconds, so events
-from different hosts land on one shared timeline without re-basing —
-cross-host skew is NTP skew, small enough to read collective alignment
-at step granularity. Every host keeps its own pid lane (relabeled
-``host <process>``), so Perfetto renders one process group per host.
+Each file input is a ``fluxmpi_tpu.trace/v1`` / kind="trace" export
+(what ``Tracer.export(path)`` / ``FLUXMPI_TPU_TRACE=<path>`` writes, one
+per host). Span timestamps are wall-clock-anchored microseconds, so
+events from different hosts land on one shared timeline without
+re-basing — cross-host skew is NTP skew, small enough to read collective
+alignment at step granularity. Every host keeps its own pid lane
+(relabeled ``host <process>``), so Perfetto renders one process group
+per host.
+
+A **directory** input is discovered recursively: every ``*.json`` /
+``*.json.gz`` under it, including the per-process ``proc<k>``
+subdirectories that ``profile_trace(all_hosts=True)`` and the
+anomaly-triggered :class:`~fluxmpi_tpu.utils.profiling.AutoProfiler`
+write into a shared logdir — a merged view of an auto-captured profile
+no longer needs hand-globbing. Discovered files are handled tolerantly:
+our kind="trace" exports merge as usual; a raw Chrome-trace JSON from
+profiler tooling (a bare ``{"traceEvents": [...]}`` or event list, the
+``.trace.json.gz`` TensorBoard's trace viewer emits) is wrapped with
+its process index inferred from the ``proc<k>`` path component; files
+that are neither are skipped with a count. Explicitly-named files keep
+the strict behavior (an invalid file is an error).
 
 The output is itself a valid kind="trace" record (extra top-level keys
 are Chrome-trace metadata, which Perfetto ignores), so
@@ -25,9 +40,11 @@ from __future__ import annotations
 
 import argparse
 import glob
+import gzip
 import importlib.util
 import json
 import os
+import re
 import sys
 import time
 
@@ -40,6 +57,69 @@ def _load_schema():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+_PROC_DIR_RE = re.compile(r"(?:^|[/\\])proc(\d+)(?:[/\\]|$)")
+
+
+def _proc_from_path(path: str) -> int:
+    """Process index from a ``proc<k>`` path component (the shared-logdir
+    layout ``profile_trace(all_hosts=True)`` writes), else 0."""
+    m = _PROC_DIR_RE.search(path)
+    return int(m.group(1)) if m else 0
+
+
+def discover(inputs: list[str]) -> list[tuple[str, bool]]:
+    """Expand the input list into ``(path, tolerant)`` pairs. Globs
+    expand; a directory is walked recursively for ``*.json`` /
+    ``*.json.gz`` (the ``proc<k>`` capture layout included) and its
+    files are tolerant (non-trace JSON skips instead of erroring);
+    explicitly-named files stay strict. A literal missing path is kept
+    so the caller errors on it."""
+    out: list[tuple[str, bool]] = []
+    for pattern in inputs:
+        matched = sorted(glob.glob(pattern))
+        if not matched:
+            out.append((pattern, False))  # missing: error below
+            continue
+        for path in matched:
+            if os.path.isdir(path):
+                found = []
+                for root, _dirs, names in os.walk(path):
+                    for name in names:
+                        if name.endswith((".json", ".json.gz")):
+                            found.append(os.path.join(root, name))
+                out.extend((p, True) for p in sorted(found))
+            else:
+                out.append((path, False))
+    return out
+
+
+def _load_json(path: str):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt", encoding="utf-8") as f:
+        return json.load(f)
+
+
+def _wrap_raw_chrome_trace(raw: object, path: str, schema) -> dict | None:
+    """Wrap a bare Chrome-trace JSON (profiler tooling output: a
+    ``{"traceEvents": [...]}`` object or a plain event list) as a
+    kind="trace" record, process inferred from the ``proc<k>`` path.
+    Returns None when the payload is not a Chrome trace at all."""
+    if isinstance(raw, list):
+        events = raw
+    elif isinstance(raw, dict) and isinstance(raw.get("traceEvents"), list):
+        events = raw["traceEvents"]
+    else:
+        return None
+    rec = {
+        "schema": schema.TRACE_SCHEMA,
+        "kind": "trace",
+        "time_unix": os.path.getmtime(path),
+        "process": _proc_from_path(path),
+        "traceEvents": events,
+    }
+    return rec if not schema.validate_trace_export(rec) else None
 
 
 def merge(records: list[dict]) -> dict:
@@ -88,36 +168,44 @@ def main(argv: list[str]) -> int:
     )
     parser.add_argument(
         "inputs", nargs="+",
-        help="per-host trace JSON files (globs are expanded)",
+        help="per-host trace JSON files (globs are expanded) and/or "
+        "capture directories (walked recursively, proc<k> subdirs "
+        "included)",
     )
     args = parser.parse_args(argv)
-
-    paths: list[str] = []
-    for pattern in args.inputs:
-        matched = sorted(glob.glob(pattern))
-        if matched:
-            paths.extend(matched)
-        else:
-            paths.append(pattern)  # literal path: missing files error below
 
     schema = _load_schema()
     records: list[dict] = []
     errors: list[str] = []
-    for path in paths:
+    skipped = 0
+    for path, tolerant in discover(args.inputs):
         if not os.path.exists(path):
             errors.append(f"{path}: no such file")
             continue
-        with open(path, "r", encoding="utf-8") as f:
-            try:
-                rec = json.load(f)
-            except json.JSONDecodeError as exc:
+        try:
+            raw = _load_json(path)
+        except (json.JSONDecodeError, OSError, UnicodeDecodeError) as exc:
+            if tolerant:
+                skipped += 1
+            else:
                 errors.append(f"{path}: not JSON: {exc}")
-                continue
-        errs = schema.validate_trace_export(rec)
-        if errs:
-            errors.extend(f"{path}: {e}" for e in errs)
             continue
-        records.append(rec)
+        errs = schema.validate_trace_export(raw)
+        if not errs:
+            records.append(raw)
+            continue
+        if tolerant:
+            # Discovered under a capture directory: accept a raw
+            # Chrome trace (profiler tooling output) by wrapping it;
+            # anything else (an xplane sidecar, an unrelated JSON) is
+            # counted and skipped, never fatal.
+            wrapped = _wrap_raw_chrome_trace(raw, path, schema)
+            if wrapped is not None:
+                records.append(wrapped)
+            else:
+                skipped += 1
+            continue
+        errors.extend(f"{path}: {e}" for e in errs)
     for e in errors:
         print(e, file=sys.stderr)
     if not records:
@@ -129,6 +217,7 @@ def main(argv: list[str]) -> int:
     print(
         f"merge_traces: {len(records)} host trace(s), "
         f"{len(merged['traceEvents'])} event(s) -> {args.output}"
+        + (f" ({skipped} discovered file(s) skipped)" if skipped else "")
         + (f" ({len(errors)} input error(s))" if errors else "")
     )
     return 1 if errors else 0

@@ -641,3 +641,46 @@ def test_bench_jsonl_merges_by_config(world, tmp_path, monkeypatch):
     assert sum(1 for rec in lines if "bench" not in rec) == 1
     # The merged stream still validates against the documented schemas.
     assert cms.check_file(str(path), schema) == []
+
+
+# ---------------------------------------------------------------------------
+# delay= entries: stall injection (the liveness-chaos producer)
+# ---------------------------------------------------------------------------
+
+
+def test_delay_modifier_grammar_round_trip():
+    spec = faults.parse_spec("data.fetch@step=2:delay=0.05")
+    assert spec.delay == pytest.approx(0.05)
+    assert spec.step == 2
+    assert "delay=0.05" in str(spec)
+    # and the canonical string re-parses to the same schedule
+    again = faults.parse_spec(str(spec))
+    assert again.delay == spec.delay and again.step == spec.step
+
+
+def test_delay_modifier_validation():
+    with pytest.raises(ValueError):
+        faults.parse_spec("data.fetch:delay=0")
+    with pytest.raises(ValueError):
+        faults.parse_spec("data.fetch:delay=-1")
+
+
+def test_delay_entry_stalls_instead_of_raising():
+    """A delay= entry is a STALL, not a crash: the firing hit sleeps in
+    place and continues — no FaultInjectedError — while still counting
+    as an injection (counter + trace instant ride the same path)."""
+    import time as _time
+
+    with faults.scope("data.fetch@step=2:delay=0.05"):
+        t0 = _time.perf_counter()
+        faults.check("data.fetch")  # hit 1: not yet
+        fast = _time.perf_counter() - t0
+        t0 = _time.perf_counter()
+        faults.check("data.fetch")  # hit 2: stalls, returns normally
+        stalled = _time.perf_counter() - t0
+        assert faults.injected_count() == 1
+        t0 = _time.perf_counter()
+        faults.check("data.fetch")  # times=1 default: spent
+        spent = _time.perf_counter() - t0
+    assert stalled >= 0.05
+    assert fast < 0.04 and spent < 0.04

@@ -805,3 +805,35 @@ def test_goodput_report_exit_codes(tmp_path):
         capture_output=True, text=True,
     )
     assert proc.returncode == 2
+
+
+def test_goodput_report_watch_rerenders_midrun(tmp_path):
+    """--watch N: the report becomes a mid-run monitor — it re-renders
+    from the growing bank on an interval (same parse path), and a bank
+    that has no data YET is a waiting state, not an error."""
+    jsonl = tmp_path / "live.jsonl"
+    reg = MetricsRegistry(sinks=[JSONLSink(str(jsonl))])
+    t = GoodputTracker(registry=reg, clock=_fake_clock(0.0, 0.0, 8.0, 10.0))
+    t.start_run()
+    with t.segment("step"):  # 8s of a 10s wall
+        pass
+    t.record()
+    reg.flush()
+    reg.close(flush=False)
+    proc = subprocess.run(
+        [sys.executable, _REPORT, str(jsonl),
+         "--watch", "0.05", "--watch-count", "2"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout.count("run: 1 host stream(s)") == 2  # re-rendered
+    assert "goodput 80.0%" in proc.stdout
+    # Missing file: the run may simply not have flushed yet — waiting,
+    # exit 0 (one-shot mode keeps its hard exit 2 for the post-mortem).
+    proc = subprocess.run(
+        [sys.executable, _REPORT, str(tmp_path / "nonexistent.jsonl"),
+         "--watch", "0.05", "--watch-count", "1"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "waiting" in proc.stderr

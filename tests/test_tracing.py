@@ -659,3 +659,47 @@ def test_profile_trace_lead_only_and_deprecated_flag(world, tmp_path, monkeypatc
         with profiling.profile_trace(str(tmp_path / "d"), host_only=False):
             pass
     assert len(calls) == 4  # lead-only, and we are the lead
+
+
+def test_merge_traces_discovers_proc_subdirectories(tmp_path):
+    """A directory input is walked recursively — including the
+    per-process proc<k> subdirectories profile_trace(all_hosts=True)
+    and the AutoProfiler write into a shared logdir — with tolerant
+    handling: our exports merge as usual, a raw Chrome trace from
+    profiler tooling (.trace.json.gz) is wrapped with its process
+    inferred from the proc<k> component, junk JSON is skipped."""
+    import gzip
+
+    logdir = tmp_path / "captures"
+    (logdir / "proc1" / "plugins" / "profile" / "r1").mkdir(parents=True)
+    tr = Tracer(enabled=True)
+    with tr.span("train.step"):
+        pass
+    (logdir / "trace.0.json").write_text(json.dumps(tr.export()))
+    raw = {"traceEvents": [
+        {"name": "xla_op", "ph": "X", "ts": 5.0, "dur": 2.0,
+         "pid": 7, "tid": 7},
+    ]}
+    with gzip.open(
+        logdir / "proc1" / "plugins" / "profile" / "r1"
+        / "host.trace.json.gz", "wt", encoding="utf-8"
+    ) as f:
+        json.dump(raw, f)
+    (logdir / "proc1" / "notes.json").write_text('{"not": "a trace"}')
+
+    out = str(tmp_path / "merged.json")
+    proc = _run_script(_MERGER, "-o", out, str(logdir))
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    assert "skipped" in proc.stdout  # the junk file, counted not fatal
+    merged = json.load(open(out, encoding="utf-8"))
+    assert validate_trace_export(merged) == []
+    assert merged["merged_from"] == [0, 1]
+    xla = [e for e in merged["traceEvents"] if e["name"] == "xla_op"]
+    assert xla and xla[0]["pid"] == 1  # process inferred from proc1/
+    spans = [e for e in merged["traceEvents"] if e["name"] == "train.step"]
+    assert spans and spans[0]["pid"] == 0
+    # An explicitly-named invalid file still errors (strict path kept).
+    proc = _run_script(
+        _MERGER, "-o", out, str(logdir / "proc1" / "notes.json")
+    )
+    assert proc.returncode == 1
