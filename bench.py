@@ -35,7 +35,8 @@ machine is never presented as a regression ratio.
 Env knobs:
   FLUXMPI_TPU_BENCH_CONFIG    force one config
                               (resnet50|cnn|mlp|attention|transformer|deq|
-                              unet — forced-only, not in the fallback plan)
+                              unet|serving — unet and serving are
+                              forced-only, not in the fallback plan)
   FLUXMPI_TPU_BENCH_TIMEOUT   override per-config child timeout in seconds
   FLUXMPI_TPU_BENCH_BUDGET    overall wall budget in seconds (default 4200;
                               sized so the 1800 s lease-TTL probe attempt
@@ -1050,6 +1051,114 @@ def _bench_attention():
     return result
 
 
+def _bench_serving():
+    """Serving plane A/B: static batching vs continuous batching on a
+    mixed-length synthetic workload (forced-only config,
+    ``FLUXMPI_TPU_BENCH_CONFIG=serving``; smoke-sized under
+    ``FLUXMPI_TPU_BENCH_SMOKE=1`` — tier-1 runs it via
+    tests/test_bench.py).
+
+    Both legs run the SAME engine machinery (paged KV cache, prefill/
+    decode split, one fixed-shape decode dispatch per iteration) — the
+    only variable is the scheduling policy: static admits a new group
+    only when every batch slot has drained (each group decodes at the
+    pace of its LONGEST request), continuous refills slots the moment
+    they free. The record banks per-leg token throughput, the speedup,
+    and the steady-state retrace count across mid-flight joins (the
+    zero-retrace claim, from the compile monitor)."""
+    import jax
+
+    import fluxmpi_tpu as fm
+    from fluxmpi_tpu.models import TransformerLM
+    from fluxmpi_tpu.serving import InferenceEngine
+    from fluxmpi_tpu.telemetry import compileplane
+
+    devs = _visible_devices()
+    fm.init(devices=devs, compileplane=True)
+    platform = devs[0].platform
+    device_kind = devs[0].device_kind
+    smoke = os.environ.get("FLUXMPI_TPU_BENCH_SMOKE") == "1"
+    if smoke or platform == "cpu":
+        dims = dict(vocab_size=64, max_len=128, num_layers=2, d_model=64,
+                    num_heads=4, d_ff=128)
+        slots, block, n_requests = 4, 8, 16
+        long_new, short_new = 48, 6
+    else:
+        dims = dict(vocab_size=8192, max_len=512, num_layers=8,
+                    d_model=512, num_heads=8, d_ff=2048)
+        slots, block, n_requests = 8, 16, 64
+        long_new, short_new = 192, 24
+    import jax.numpy as jnp
+
+    model = TransformerLM(**dims)
+    rng = np.random.default_rng(0)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32), train=False
+    )
+    # Mixed lengths: every slots-th request is long — exactly the shape
+    # static batching is worst at (the whole gang waits for it).
+    workload = []
+    for i in range(n_requests):
+        plen = int(rng.integers(4, 2 * block))
+        max_new = long_new if i % slots == 0 else short_new
+        workload.append(
+            (rng.integers(0, dims["vocab_size"], size=(plen,)).astype(np.int32),
+             max_new)
+        )
+    buckets = tuple(p.shape[0] for p, _ in workload)
+    mon = compileplane.get_compile_monitor()
+
+    legs = {}
+    retraces = 0
+    for name, continuous in (("static", False), ("continuous", True)):
+        eng = InferenceEngine(
+            model, params, slots=slots, block_size=block,
+            max_queue=n_requests, continuous=continuous,
+        )
+        eng.warmup(prompt_lengths=buckets)
+        mon.observe_flush()  # steady-state boundary for this leg
+        for prompt, max_new in workload:
+            eng.submit(prompt, max_new)
+        summary = eng.run()
+        info = mon.observe_flush()
+        retraces += info["events"]
+        legs[name] = {
+            "tokens": summary["tokens"],
+            "decode_steps": summary["decode_steps"],
+            "wall_seconds": round(summary["wall_seconds"], 4),
+            "tokens_per_sec": round(summary["tokens_per_sec"], 1),
+        }
+        eng.close()
+    speedup = (
+        round(legs["continuous"]["tokens_per_sec"]
+              / legs["static"]["tokens_per_sec"], 3)
+        if legs["static"]["tokens_per_sec"] else None
+    )
+    value = legs["continuous"]["tokens_per_sec"]
+    metric = "serving_tokens_per_sec"
+    anchor = _anchor_for(metric)
+    return {
+        "metric": metric,
+        "value": value,
+        "unit": "tokens/sec",
+        "vs_baseline": round(value / anchor, 4) if anchor else 1.0,
+        "platform": platform,
+        "device_kind": device_kind,
+        "n_chips": 1,
+        "serving": {
+            "requests": n_requests,
+            "slots": slots,
+            "block_size": block,
+            "long_new": long_new,
+            "short_new": short_new,
+            "static": legs["static"],
+            "continuous": legs["continuous"],
+            "speedup": speedup,
+            "steady_retraces": retraces,
+        },
+    }
+
+
 _CHILD_FNS = {
     "resnet50": _bench_resnet50,
     "cnn": _bench_cnn,
@@ -1058,6 +1167,7 @@ _CHILD_FNS = {
     "transformer": _bench_transformer,
     "deq": _bench_deq,
     "unet": _bench_unet,
+    "serving": _bench_serving,
 }
 
 
@@ -1451,14 +1561,20 @@ def _run_smoke(remaining) -> None:
     covers it)."""
     os.environ.setdefault("FLUXMPI_TPU_BENCH_STEPS", "6")
     os.environ.setdefault("FLUXMPI_TPU_BENCH_MLP_BATCH", "256")
-    result = _run_child("mlp", 240.0, "cpu")
+    # A forced config rides smoke mode too (the serving A/B's tier-1
+    # entry point: FLUXMPI_TPU_BENCH_SMOKE=1 + _CONFIG=serving); the
+    # scaling pair only applies to the default mlp smoke.
+    config = os.environ.get("FLUXMPI_TPU_BENCH_CONFIG") or "mlp"
+    result = _run_child(config, 240.0, "cpu")
     if result is None:
         result = {"metric": "bench_failed", "value": 0.0, "unit": "none",
-                  "vs_baseline": 0.0, "config": "mlp", "platform": "cpu"}
+                  "vs_baseline": 0.0, "config": config, "platform": "cpu"}
     # Marked on failures too: a CI smoke crash must never read as a real
     # benchmark round in the shared JSONL trajectory.
     result["smoke"] = 1
-    if os.environ.get("FLUXMPI_TPU_BENCH_SMOKE_SCALING", "1") == "1":
+    if config == "mlp" and os.environ.get(
+        "FLUXMPI_TPU_BENCH_SMOKE_SCALING", "1"
+    ) == "1":
         scaling = _run_scaling(min(remaining(), 340.0), None, None)
         if scaling is not None:
             result["scaling"] = scaling

@@ -31,6 +31,7 @@ Public surface (parity with reference exports, src/FluxMPI.jl:88-96):
 from . import config  # noqa: F401
 from . import telemetry  # noqa: F401
 from . import faults  # noqa: F401
+from . import serving  # noqa: F401
 from .errors import (  # noqa: F401
     CheckpointDesyncError,
     CheckpointTimeoutError,

@@ -30,6 +30,12 @@ site                  where it fires
 ``ckpt.read``         :func:`~fluxmpi_tpu.utils.checkpoint.restore_checkpoint`
 ``elastic.restore``   the explicit elastic restore path (``mesh=``/``rule=``
                       template building, before any bytes move)
+``serving.admit``     :meth:`fluxmpi_tpu.serving.InferenceEngine.submit`
+                      (the admission-control entry — a crash there is a
+                      rejected/failed submission, not a dead engine)
+``serving.decode``    each engine decode iteration, before the dispatch
+                      (pair with ``delay=`` to stall the loop and watch
+                      ``/healthz`` flip)
 ====================  =====================================================
 
 A firing site raises :class:`FaultInjectedError` (re-exported from
@@ -139,6 +145,8 @@ KNOWN_SITES = frozenset(
         "ckpt.commit",
         "ckpt.read",
         "elastic.restore",
+        "serving.admit",
+        "serving.decode",
     }
 )
 

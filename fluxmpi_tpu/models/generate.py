@@ -15,12 +15,20 @@ are training-time constructs and are bypassed, see
 training and decode configurations, so trained checkpoints load
 directly.
 
-Known tradeoff: the prompt prefills through the same one-token-per-tick
-scan (O(prompt_len) sequential steps) rather than a batched causal
-forward that writes K/V projections into the caches in one pass — the
-single-scan design keeps the whole loop one compiled program with no
-module-internal cache surgery; swap in a batched prefill if long-prompt
-time-to-first-token ever matters here.
+Prefill: the prompt populates the KV caches through ONE batched causal
+forward (:func:`prefill_kv` / :func:`prefill_cache` — the train-mode
+model runs over the whole prompt, the per-layer pre-attention
+LayerNorm outputs are captured, and the K/V projections are applied
+outside the module and written into the flax cache in one pass), so
+time-to-first-token is O(1) forwards instead of O(prompt_len)
+sequential scan ticks. ``generate(prefill="scan")`` keeps the original
+one-token-per-tick prefill (the whole loop stays a single compiled
+program); the two paths are bit-for-bit equivalence-tested for greedy
+decoding, the default ``"auto"`` only takes the batched path for
+models that declare it token-exact (``batched_prefill_safe`` — MoE
+capacity routing keeps the scan, see the MoE note below), and the
+batched kernel is also what the serving plane's prefill phase calls
+(:mod:`fluxmpi_tpu.serving`).
 
 MoE note: capacity-based routing can DROP over-capacity tokens in a
 batched forward that single-token decode never drops, so an MoE LM's
@@ -31,10 +39,12 @@ argmax loop unless capacity is ample (see
 
 from __future__ import annotations
 
+import re
+
 import jax
 import jax.numpy as jnp
 
-__all__ = ["generate", "beam_search"]
+__all__ = ["generate", "beam_search", "prefill_kv", "prefill_cache"]
 
 
 def _decode_twin(model):
@@ -85,6 +95,140 @@ def _sized_cache(twin, rows: int, total: int):
     )
 
 
+_BLOCK_RE = re.compile(r"block_(\d+)$")
+
+
+def layer_index(path) -> int:
+    """Encoder-layer index of a cache/params tree path (the ``block_<i>``
+    component of :class:`TransformerLM`'s module tree). Shared by the
+    batched prefill below and the serving plane's block-cache
+    gather/scatter, which both need a stable layer ordering that survives
+    ``block_10`` sorting after ``block_2``."""
+    for entry in path:
+        key = getattr(entry, "key", None)
+        if isinstance(key, str):
+            m = _BLOCK_RE.match(key)
+            if m:
+                return int(m.group(1))
+    raise ValueError(
+        f"no block_<i> component in cache path {jax.tree_util.keystr(path)!r}"
+        " — the model's encoder layers are not TransformerLM-shaped"
+    )
+
+
+def _is_ln1(path) -> bool:
+    keys = [getattr(e, "key", None) for e in path]
+    return "ln1" in keys
+
+
+def prefill_kv(model, params, tokens: jnp.ndarray):
+    """K/V projections for every prompt position from ONE batched causal
+    forward — the O(1)-forwards prefill kernel.
+
+    Runs the TRAINING-configuration model (causal mask, no cache) over
+    ``tokens`` ``[batch, plen]``, capturing each block's pre-attention
+    LayerNorm (``ln1``) output, and applies the attention ``key`` /
+    ``value`` projections outside the module — exactly the tensors
+    flax's decode cache banks per position, computed for all positions
+    at once. Right-padding is safe: the causal mask keeps positions
+    ``< plen_r`` of a row independent of anything after them, so callers
+    with ragged prompts pad, prefill, and discard the tail.
+
+    Returns ``(k, v, logits)``: ``k``/``v`` are
+    ``[num_layers, batch, plen, num_heads, head_dim]`` in cache layer
+    order (:func:`layer_index`), ``logits`` is the full-sequence
+    ``[batch, plen, vocab]`` (position ``plen - 1`` is the
+    next-token distribution after the whole prompt).
+    """
+    fwd = model.clone(decode=False, attention_fn=None, dropout=0.0)
+    logits, state = fwd.apply(
+        {"params": params["params"]},
+        tokens.astype(jnp.int32),
+        train=False,
+        capture_intermediates=lambda mdl, _: mdl.name == "ln1",
+        mutable=["intermediates"],
+    )
+    flat_h = [
+        (layer_index(path), leaf)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+            state["intermediates"]
+        )[0]
+        if _is_ln1(path)
+    ]
+    flat_h.sort(key=lambda t: t[0])
+    proj: dict[int, dict[str, dict[str, jnp.ndarray]]] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(
+        params["params"]
+    )[0]:
+        keys = [getattr(e, "key", None) for e in path]
+        if "attn" in keys and keys[-2] in ("key", "value"):
+            proj.setdefault(layer_index(path), {}).setdefault(
+                keys[-2], {}
+            )[keys[-1]] = leaf
+    if len(flat_h) != len(proj):
+        raise ValueError(
+            f"captured {len(flat_h)} ln1 outputs but found attention "
+            f"projections for {len(proj)} layers — the model is not "
+            f"TransformerLM-shaped"
+        )
+    dtype = model.dtype
+    ks, vs = [], []
+    for idx, h in flat_h:
+        h = h.astype(dtype)
+        layer = proj[idx]
+        for which, out in (("key", ks), ("value", vs)):
+            p = layer[which]
+            # The same contraction DenseGeneral performs (kernel
+            # [d_model, heads, head_dim], promoted to the module dtype).
+            y = jnp.einsum("bld,dhn->blhn", h, p["kernel"].astype(dtype))
+            if "bias" in p:
+                y = y + p["bias"].astype(dtype)
+            out.append(y)
+    return jnp.stack(ks), jnp.stack(vs), logits
+
+
+def cache_template(twin, rows: int, total: int):
+    """Shape/dtype skeleton of the decode twin's flax cache for ``rows``
+    sequences of length ``total`` (eval_shape only — no forward pass)."""
+    return jax.eval_shape(
+        lambda: twin.init(
+            jax.random.PRNGKey(0), jnp.zeros((rows, total), jnp.int32),
+            train=False,
+        )["cache"]
+    )
+
+
+def prefill_cache(model, params, prompt: jnp.ndarray, total: int):
+    """Batched prefill into a fresh flax decode cache.
+
+    One causal forward (:func:`prefill_kv`) writes the prompt's K/V into
+    a cache sized for ``total`` positions, with every layer's
+    ``cache_index`` advanced past the prompt — the state the
+    one-token-per-tick scan would reach after ``plen`` ticks, in one
+    pass. Returns ``(cache, last_logits)`` where ``last_logits``
+    ``[batch, vocab]`` is the next-token distribution after the prompt.
+    """
+    b, plen = prompt.shape
+    twin = _decode_twin(model)
+    k, v, logits = prefill_kv(model, params, prompt)
+    tmpl = cache_template(twin, b, total)
+
+    def fill(path, leaf):
+        name = path[-1].key
+        if name == "cached_key":
+            z = jnp.zeros(leaf.shape, leaf.dtype)
+            return z.at[:, :plen].set(k[layer_index(path)].astype(leaf.dtype))
+        if name == "cached_value":
+            z = jnp.zeros(leaf.shape, leaf.dtype)
+            return z.at[:, :plen].set(v[layer_index(path)].astype(leaf.dtype))
+        if name == "cache_index":
+            return jnp.asarray(plen, leaf.dtype)
+        return jnp.zeros(leaf.shape, leaf.dtype)
+
+    cache = jax.tree_util.tree_map_with_path(fill, tmpl)
+    return cache, logits[:, plen - 1]
+
+
 def generate(
     model,
     params,
@@ -96,6 +240,7 @@ def generate(
     top_p: float | None = None,
     eos_token: int | None = None,
     rng: jax.Array | None = None,
+    prefill: str = "auto",
 ) -> jnp.ndarray:
     """Generate ``max_new_tokens`` continuations of ``prompt``.
 
@@ -117,6 +262,21 @@ def generate(
       eos_token: once a row emits this token, every later position in
         that row is forced to it (shapes stay static; the scan still
         runs ``max_new_tokens`` ticks).
+      prefill: ``"batched"`` warms the KV cache with ONE causal forward
+        over the prompt (:func:`prefill_cache`) and scans only the
+        ``max_new_tokens`` decode ticks; ``"scan"`` teacher-forces the
+        prompt through the original one-token-per-tick scan
+        (O(prompt_len) sequential steps, but the whole loop is a single
+        compiled program). For models whose batched forward is
+        token-exact with single-position decoding (plain dense
+        :class:`TransformerLM`) the two paths are bit-identical — the
+        rng stream advances once per tick either way, so sampled
+        continuations match too. ``"auto"`` (default) picks batched
+        exactly for those models (``model.batched_prefill_safe``) and
+        keeps the scan for the rest — MoE capacity routing can drop
+        over-capacity prompt tokens in a batched forward that the
+        one-token ticks never drop, so a silent switch would change
+        MoE outputs.
 
     Returns:
       int32 ``[batch, prompt_len + max_new_tokens]`` — the prompt
@@ -133,11 +293,20 @@ def generate(
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     _validate_eos(model, eos_token)
+    if prefill not in ("auto", "batched", "scan"):
+        raise ValueError(
+            f"prefill must be 'auto', 'batched', or 'scan', got {prefill!r}"
+        )
+    if prefill == "auto":
+        prefill = (
+            "batched"
+            if getattr(model, "batched_prefill_safe", False)
+            else "scan"
+        )
     if rng is None:
         rng = jax.random.PRNGKey(0)
 
     twin = _decode_twin(model)
-    cache = _sized_cache(twin, b, total)
     prompt = prompt.astype(jnp.int32)
 
     def body(carry, _):
@@ -181,6 +350,23 @@ def generate(
             done = done | ((nxt == eos_token) & jnp.logical_not(in_prompt))
         return (mutated["cache"], nxt[:, None], pos + 1, rng, done), nxt
 
+    if prefill == "batched" and plen > 1:
+        # Positions 0..plen-2 land in the cache in one forward; the scan
+        # starts at the LAST prompt token (the first tick whose output
+        # is a real continuation — identical to where the scan path's
+        # teacher forcing ends). The scan path burns one rng split per
+        # prompt tick; replay those splits so the decode-tick stream —
+        # and therefore every sampled continuation — is bit-identical.
+        cache, _ = prefill_cache(model, params, prompt[:, : plen - 1], total)
+        for _ in range(plen - 1):
+            rng, _ = jax.random.split(rng)
+        init = (cache, prompt[:, plen - 1:], jnp.asarray(plen - 1), rng,
+                jnp.zeros((b,), bool))
+        _, toks = jax.lax.scan(body, init, None, length=max_new_tokens)
+        # toks: [max_new_tokens, b] — tokens for positions plen..total-1.
+        return jnp.concatenate([prompt, toks.T], axis=1)
+
+    cache = _sized_cache(twin, b, total)
     init = (cache, prompt[:, :1], jnp.asarray(0), rng,
             jnp.zeros((b,), bool))
     _, toks = jax.lax.scan(body, init, None, length=total - 1)

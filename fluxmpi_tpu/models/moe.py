@@ -440,6 +440,13 @@ class MoETransformerLM(TransformerLM):
     :class:`fluxmpi_tpu.models.transformer.TransformerLM`; expert weights
     live at ``encoder/block_i/moe/{w1,b1,w2,b2}``)."""
 
+    # Capacity-based routing can DROP over-capacity tokens in a batched
+    # prompt forward that single-token decode never drops (the known
+    # generate() caveat) — a batched prefill is therefore NOT
+    # token-exact with the scan prefill here; generate()'s "auto"
+    # default keeps the one-token-per-tick scan for MoE.
+    batched_prefill_safe = False
+
     num_experts: int = 8
     capacity_factor: float = 1.25
     n_groups: int | None = None

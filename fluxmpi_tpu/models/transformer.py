@@ -113,6 +113,15 @@ class TransformerLM(nn.Module):
     head (weight-tied). Subclasses override :meth:`make_encoder` to swap the
     block type (e.g. :class:`fluxmpi_tpu.models.moe.MoETransformerLM`)."""
 
+    # Whether a batched causal forward over the prompt is token-exact
+    # with single-position decoding — the gate for generate()'s default
+    # batched prefill. Plain dense blocks: yes. Subclasses whose
+    # batched forward computes DIFFERENT per-token functions (MoE
+    # capacity routing drops over-capacity tokens a one-token tick
+    # never drops) override this to False and keep the scan prefill.
+    # Deliberately a plain class attribute, not a dataclass field.
+    batched_prefill_safe = True
+
     vocab_size: int = 1024
     max_len: int = 512
     num_layers: int = 4

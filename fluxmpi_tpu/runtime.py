@@ -306,6 +306,7 @@ def init(
     profile: Any = None,
     compile_cache: Any = None,
     export: Any = None,
+    serving: Any = None,
 ) -> Mesh:
     """Bring up the fluxmpi_tpu runtime. Idempotent.
 
@@ -412,6 +413,13 @@ def init(
         ``FLUXMPI_TPU_EXPORT_ADDR``). Poll a fleet with
         ``scripts/fluxmpi_top.py``; see docs/observability.md
         "Live export".
+      serving: set the serving plane's fleet defaults — ``True`` (or a
+        dict with ``slots`` / ``block_size`` / ``num_blocks`` /
+        ``max_queue``) seeds
+        :class:`~fluxmpi_tpu.serving.InferenceEngine` geometry,
+        otherwise read from ``FLUXMPI_TPU_SERVING`` (+ ``_SLOTS`` /
+        ``_BLOCK_SIZE`` / ``_BLOCKS`` / ``_QUEUE``); ``False`` resets
+        the plane (any running engine stopped). See docs/serving.md.
 
     Returns:
       The global :class:`jax.sharding.Mesh`.
@@ -427,6 +435,7 @@ def init(
     from .telemetry import watchdog as _watchdog
     from .utils import profiling as _profiling
     from . import faults as _faults_mod
+    from . import serving as _serving
 
     if _state.initialized:
         _configure_telemetry(telemetry)
@@ -441,6 +450,7 @@ def init(
         _profiling.configure_auto_profiler(profile)
         _configure_compile_cache(compile_cache)
         _export.configure(export)
+        _serving.configure(serving)
         if verbose:
             fluxmpi_println("fluxmpi_tpu already initialized; skipping...")
         assert _state.mesh is not None
@@ -502,6 +512,7 @@ def init(
     _profiling.configure_auto_profiler(profile)
     _configure_compile_cache(compile_cache)
     _export.configure(export)
+    _serving.configure(serving)
 
     if verbose:
         if total_workers() == 1:

@@ -1,0 +1,50 @@
+"""Serving plane: continuous-batching inference with a paged KV cache.
+
+The "millions of users" leg of the north star (ROADMAP open item 1):
+:class:`InferenceEngine` turns the batch-level research decode API
+(:func:`fluxmpi_tpu.models.generate`) into a traffic-serving loop —
+request queue + token-budget admission control, an Orca-style
+continuous-batching scheduler (new requests join the in-flight decode
+batch between iterations, zero retrace), a vLLM-style block/paged KV
+cache (:class:`BlockKVCache` — heterogeneous sequence lengths share
+device memory through a free-list allocator and per-sequence block
+tables), a prefill/decode phase split (prefill = ONE batched causal
+forward via :func:`fluxmpi_tpu.models.generate.prefill_kv`), and
+streaming token output with per-request latency accounting (TTFT,
+per-token, queue wait) on the closed ``serving.*`` metric namespace.
+
+The engine meets the rest of the production surface where it already
+lives: ``serving.admit`` / ``serving.decode`` fault sites
+(:mod:`fluxmpi_tpu.faults`), SIGTERM preemption draining (in-flight
+requests finish, new admissions reject), the watchdog progress clock
+(a stuck decode flips ``/healthz``), and a serving board on the live
+exporter's ``/status`` (``scripts/fluxmpi_top.py`` renders it
+fleet-wide). See docs/serving.md.
+"""
+
+from __future__ import annotations
+
+from .cache import BlockKVCache, blocks_for_tokens  # noqa: F401
+from .engine import (  # noqa: F401
+    InferenceEngine,
+    ServingConfig,
+    ServingRequest,
+    configure,
+    enabled,
+    get_engine,
+    set_engine,
+    shutdown,
+)
+
+__all__ = [
+    "BlockKVCache",
+    "blocks_for_tokens",
+    "InferenceEngine",
+    "ServingConfig",
+    "ServingRequest",
+    "configure",
+    "enabled",
+    "get_engine",
+    "set_engine",
+    "shutdown",
+]

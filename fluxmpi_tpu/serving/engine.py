@@ -1,0 +1,1211 @@
+"""Continuous-batching inference engine over the TransformerLM decode twin.
+
+The serving plane's core loop (ROADMAP open item 1 — the "millions of
+users" leg): an Orca-style **continuous-batching** scheduler where new
+requests join the in-flight decode batch *between* iterations, built
+from the pieces this repo already has — the decode twin of
+:mod:`fluxmpi_tpu.models.generate`, the batched prefill kernel
+(:func:`~fluxmpi_tpu.models.generate.prefill_kv`), the paged
+:class:`~fluxmpi_tpu.serving.cache.BlockKVCache`, the ``serving.*``
+telemetry namespace, the watchdog's progress clock (``/healthz`` covers
+a stuck decode), and the fault plane (``serving.admit`` /
+``serving.decode`` chaos sites, SIGTERM drain).
+
+Phase split:
+
+- **prefill** — one batched causal forward per admission writes the
+  whole prompt's K/V into the request's pool blocks and yields the
+  first generated token (TTFT = one forward, not O(prompt) ticks).
+  Prefill programs are compiled per *prompt bucket* (prompt length
+  rounded up to a block multiple) — a handful of shapes, warmed by
+  :meth:`InferenceEngine.warmup`.
+- **decode** — ONE fixed-shape jitted step per engine iteration runs
+  every active batch slot one token forward: gather each slot's blocks
+  into the contiguous cache layout the flax decode twin expects, run
+  the twin per slot (vmapped, so every slot carries its *own* cache
+  index/position — heterogeneous sequence states in one dispatch),
+  scatter the newly written K/V position back into the pool, and
+  return the argmax tokens. Shapes depend only on the engine geometry
+  ``(slots, max_blocks_per_seq, block_size)`` — never on which
+  requests are active — so **requests join and leave the batch with
+  zero retrace** (the compile monitor asserts this in the tests and
+  the bench).
+
+The decode loop is **host-driven** (``lax.scan``-free): one dispatch +
+one small device→host token transfer per iteration, with eviction,
+admission, streaming delivery, and preemption polling between
+iterations — the same boundary discipline as ``train_loop``'s dispatch
+loop, including the PR 4 zero-cost instrumentation contract (the
+registry/exporter are resolved ONCE per run; fully-off pays no
+per-token clock reads or handle lookups beyond the per-request
+latency stamps that are the serving API itself).
+
+Wiring follows the package convention: ``init(serving=...)`` /
+``FLUXMPI_TPU_SERVING`` (+ ``_SLOTS`` / ``_BLOCK_SIZE`` / ``_BLOCKS`` /
+``_QUEUE``) set fleet defaults via :func:`configure`;
+``telemetry.shutdown()`` resets the plane (engine stopped, pools
+dropped — the fault-plane leak rule). See docs/serving.md.
+"""
+
+from __future__ import annotations
+
+import os
+import queue as queue_mod
+import threading
+import time
+import warnings
+from collections import deque
+from typing import Any, Callable
+
+import numpy as np
+
+from ..telemetry.registry import MetricsRegistry, get_registry
+from .cache import BlockKVCache, TRASH_BLOCK, blocks_for_tokens
+
+__all__ = [
+    "InferenceEngine",
+    "ServingRequest",
+    "ServingConfig",
+    "get_engine",
+    "set_engine",
+    "configure",
+    "shutdown",
+    "enabled",
+]
+
+_ENV_ON = "FLUXMPI_TPU_SERVING"
+_ENV_SLOTS = "FLUXMPI_TPU_SERVING_SLOTS"
+_ENV_BLOCK_SIZE = "FLUXMPI_TPU_SERVING_BLOCK_SIZE"
+_ENV_BLOCKS = "FLUXMPI_TPU_SERVING_BLOCKS"
+_ENV_QUEUE = "FLUXMPI_TPU_SERVING_QUEUE"
+
+_DEFAULT_SLOTS = 8
+_DEFAULT_BLOCK_SIZE = 16
+_DEFAULT_MAX_QUEUE = 64
+
+
+def _env_int(name: str) -> int | None:
+    """An int env knob; garbage warns and falls back to None (the
+    faults.configure env-typo convention — a typo degrades, never
+    crashes a serving job)."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        warnings.warn(
+            f"ignoring {name}={raw!r}: not an integer", stacklevel=3
+        )
+        return None
+
+
+class ServingConfig:
+    """Fleet defaults for engine geometry (``init(serving=...)`` /
+    ``FLUXMPI_TPU_SERVING_*``). ``None`` fields defer to the env var,
+    then the built-in default, at engine construction."""
+
+    def __init__(
+        self,
+        *,
+        slots: int | None = None,
+        block_size: int | None = None,
+        num_blocks: int | None = None,
+        max_queue: int | None = None,
+    ):
+        self.slots = slots
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        self.max_queue = max_queue
+
+
+_config: ServingConfig | None = None
+_active_engine: "InferenceEngine | None" = None
+_active_lock = threading.Lock()
+
+
+def get_engine() -> "InferenceEngine | None":
+    """The registered engine, if any (the last one constructed; None =
+    plane off)."""
+    return _active_engine
+
+
+def set_engine(engine: "InferenceEngine | None") -> "InferenceEngine | None":
+    """Register (or, with None, remove) the process engine; returns the
+    previous one."""
+    global _active_engine
+    with _active_lock:
+        prev, _active_engine = _active_engine, engine
+    return prev
+
+
+def enabled() -> bool:
+    """Whether ``init(serving=...)`` / ``FLUXMPI_TPU_SERVING`` marked
+    the plane configured (engine construction never requires it — this
+    is the fleet-defaults switch)."""
+    return _config is not None
+
+
+def configure(spec: Any = None) -> ServingConfig | None:
+    """Wire serving fleet defaults from a one-value spec (the
+    :func:`fluxmpi_tpu.telemetry.configure` shape):
+
+    - ``None`` — read ``FLUXMPI_TPU_SERVING`` (no-op when unset/empty);
+    - ``False`` / ``"0"`` — reset the plane (stop + deregister any
+      running engine, drop the defaults);
+    - ``True`` / ``"1"`` — enable with env-derived geometry
+      (``FLUXMPI_TPU_SERVING_SLOTS`` / ``_BLOCK_SIZE`` / ``_BLOCKS`` /
+      ``_QUEUE``);
+    - a dict — enable with those geometry overrides (same keys as
+      :class:`ServingConfig`);
+    - a :class:`ServingConfig` — install it.
+
+    Called by ``fluxmpi_tpu.init(serving=...)``, idempotent replays
+    included.
+    """
+    global _config
+    from_env = spec is None
+    if spec is None:
+        spec = os.environ.get(_ENV_ON)
+        if spec is None or spec == "":
+            return _config
+    if spec is False or spec == "0":
+        shutdown()
+        return None
+    if isinstance(spec, ServingConfig):
+        _config = spec
+        return _config
+    if spec is True or spec == "1":
+        _config = ServingConfig()
+        return _config
+    if isinstance(spec, dict):
+        unknown = set(spec) - {"slots", "block_size", "num_blocks", "max_queue"}
+        if unknown:
+            raise ValueError(
+                f"unknown serving config keys {sorted(unknown)}; expected "
+                f"slots/block_size/num_blocks/max_queue"
+            )
+        _config = ServingConfig(**spec)
+        return _config
+    message = (
+        f"serving spec must be a bool, '0'/'1', a dict, or a "
+        f"ServingConfig; got {spec!r}"
+    )
+    if from_env:
+        # The export-plane convention: an env typo (FLUXMPI_TPU_SERVING=
+        # "true") degrades with a warning instead of crashing every
+        # init() of a job that may never even serve.
+        warnings.warn(
+            f"ignoring {_ENV_ON}={spec!r}: {message} — the serving "
+            f"plane defaults stay unset",
+            stacklevel=2,
+        )
+        return _config
+    raise ValueError(message)
+
+
+def shutdown() -> None:
+    """Reset the serving plane: stop and deregister the engine (serve
+    thread joined, queued/active requests failed, KV pools dropped) and
+    clear the configured defaults — state left armed would leak into
+    the next init cycle (the fault-plane leak rule).
+    ``telemetry.shutdown()`` calls this before tearing down the planes
+    the engine posts into."""
+    global _config
+    engine = set_engine(None)
+    if engine is not None:
+        close = getattr(engine, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:
+                pass
+    _config = None
+
+
+def _resolve(explicit: int | None, configured: int | None,
+             env_name: str, default: int) -> int:
+    if explicit is not None:
+        return int(explicit)
+    if configured is not None:
+        return int(configured)
+    env = _env_int(env_name)
+    if env is not None:
+        return env
+    return default
+
+
+# ---------------------------------------------------------------------------
+# Requests
+# ---------------------------------------------------------------------------
+
+QUEUED = "queued"
+ACTIVE = "active"
+FINISHED = "finished"
+REJECTED = "rejected"
+
+
+class ServingRequest:
+    """One submitted generation request: prompt in, streamed tokens out.
+
+    The handle the engine returns from :meth:`InferenceEngine.submit`.
+    Tokens arrive three ways as decode progresses: the ``on_token``
+    callback (fired from the engine thread — keep it cheap), the
+    :meth:`stream` iterator (a bounded queue the consumer drains from
+    any thread), and the accumulated :attr:`tokens` list. Latency
+    accounting rides the handle: :attr:`queue_wait_s` (submit →
+    admission), :attr:`ttft_s` (submit → first token), and
+    :attr:`per_token_s` (mean inter-token time after the first).
+    """
+
+    def __init__(
+        self,
+        prompt: np.ndarray,
+        max_new_tokens: int,
+        *,
+        eos_token: int | None = None,
+        on_token: Callable[[int], None] | None = None,
+        clock: Callable[[], float] = time.perf_counter,
+    ):
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token = eos_token
+        self.on_token = on_token
+        self.tokens: list[int] = []
+        self.status = QUEUED
+        self.reject_reason: str | None = None
+        self._clock = clock
+        self.submitted_t = clock()
+        self.admitted_t: float | None = None
+        self.first_token_t: float | None = None
+        self.finished_t: float | None = None
+        self._done = threading.Event()
+        self._stream: queue_mod.SimpleQueue = queue_mod.SimpleQueue()
+
+    # -- consumer side -------------------------------------------------
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until the request finishes (or is rejected)."""
+        return self._done.wait(timeout)
+
+    def result(self, timeout: float | None = None) -> np.ndarray:
+        """The full sequence (prompt + generated tokens) once finished;
+        raises ``RuntimeError`` for rejected requests."""
+        if not self.wait(timeout):
+            raise TimeoutError("request still in flight")
+        if self.status == REJECTED:
+            raise RuntimeError(
+                f"request rejected ({self.reject_reason})"
+            )
+        return np.concatenate(
+            [self.prompt, np.asarray(self.tokens, np.int32)]
+        )
+
+    def stream(self, timeout: float | None = None):
+        """Yield generated tokens as the engine produces them (ends at
+        completion; raises ``RuntimeError`` on rejection and
+        ``TimeoutError`` when ``timeout`` seconds pass without a token
+        — the same exception :meth:`result` uses, not the internal
+        queue's). Drive the engine from another thread
+        (:meth:`InferenceEngine.start`) or interleave with
+        :meth:`InferenceEngine.step` calls."""
+        while True:
+            try:
+                tok = self._stream.get(timeout=timeout)
+            except queue_mod.Empty:
+                raise TimeoutError(
+                    f"no token within {timeout} seconds"
+                ) from None
+            if tok is None:
+                if self.status == REJECTED:
+                    raise RuntimeError(
+                        f"request rejected ({self.reject_reason})"
+                    )
+                return
+            yield tok
+
+    # -- latency accounting --------------------------------------------
+
+    @property
+    def queue_wait_s(self) -> float | None:
+        if self.admitted_t is None:
+            return None
+        return self.admitted_t - self.submitted_t
+
+    @property
+    def ttft_s(self) -> float | None:
+        if self.first_token_t is None:
+            return None
+        return self.first_token_t - self.submitted_t
+
+    @property
+    def per_token_s(self) -> float | None:
+        """Mean inter-token latency after the first token (None until
+        finished or with a single generated token)."""
+        if self.finished_t is None or self.first_token_t is None:
+            return None
+        n = len(self.tokens)
+        if n < 2:
+            return None
+        return (self.finished_t - self.first_token_t) / (n - 1)
+
+    # -- engine side ---------------------------------------------------
+
+    def _deliver(self, token: int) -> None:
+        if self.first_token_t is None:
+            self.first_token_t = self._clock()
+        self.tokens.append(int(token))
+        self._stream.put(int(token))
+        if self.on_token is not None:
+            try:
+                self.on_token(int(token))
+            except Exception as exc:
+                warnings.warn(
+                    f"serving on_token callback raised {exc!r}; token "
+                    f"delivery continues",
+                    stacklevel=2,
+                )
+
+    def _finish(self, status: str, reason: str | None = None) -> None:
+        self.status = status
+        self.reject_reason = reason
+        self.finished_t = self._clock()
+        self._stream.put(None)
+        self._done.set()
+
+
+class _Slot:
+    """One active batch slot: the request plus its device-side cursor."""
+
+    __slots__ = ("req", "blocks", "table", "position", "last_token",
+                 "generated")
+
+    def __init__(self, req: ServingRequest, blocks: list[int],
+                 table: np.ndarray):
+        self.req = req
+        self.blocks = blocks
+        self.table = table
+        # Cache positions filled so far == the position the NEXT fed
+        # token occupies; after prefill this is the prompt length.
+        self.position = 0
+        self.last_token = 0
+        self.generated = 0
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+
+
+class InferenceEngine:
+    """Continuous-batching inference engine with a paged KV cache.
+
+    Args:
+      model: a :class:`~fluxmpi_tpu.models.TransformerLM` (training
+        configuration — the decode twin is derived internally, exactly
+        like :func:`~fluxmpi_tpu.models.generate`).
+      params: its variables (``{"params": ...}``).
+      slots: static decode batch width (default: ``init(serving=)`` /
+        ``FLUXMPI_TPU_SERVING_SLOTS`` / 8). The decode step's shapes
+        are fixed by this — joins/evictions never retrace.
+      block_size: KV cache positions per pool block (default env /16).
+      num_blocks: pool size in blocks, including the reserved trash
+        block (default env / ``1 + slots * max_len/block_size`` — no
+        oversubscription; size it DOWN to make admission control bite).
+      max_queue: queued (admitted-later) requests past which
+        :meth:`submit` load-sheds with a rejection (default env / 64).
+      max_len: per-sequence cap on ``prompt + max_new_tokens`` (default
+        ``model.max_len`` rounded down to a block multiple).
+      continuous: True (default) = requests join the decode batch
+        between any two iterations; False = static batching (a new
+        group is admitted only when every slot has drained — the A/B
+        baseline ``bench.py --child serving`` measures against).
+      slo_ttft_s / slo_token_s: optional latency objectives; completions
+        breaching them bump ``serving.slo_violations{kind=...}``.
+      registry: metrics registry (default: the process-global one,
+        resolved once per run — the zero-cost contract).
+      clock: time source for latency accounting (injectable for tests).
+      check_memory: verify the pool's byte footprint against the memory
+        plane's device ``bytes_limit`` before allocating (raises
+        ``RuntimeError`` when it cannot fit — OOM-safe admission starts
+        at construction).
+
+    The engine registers itself as the module's active engine
+    (:func:`get_engine`) so the live export plane's ``/status`` board
+    and ``telemetry.shutdown()`` can find it.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        *,
+        slots: int | None = None,
+        block_size: int | None = None,
+        num_blocks: int | None = None,
+        max_queue: int | None = None,
+        max_len: int | None = None,
+        continuous: bool = True,
+        slo_ttft_s: float | None = None,
+        slo_token_s: float | None = None,
+        registry: MetricsRegistry | None = None,
+        clock: Callable[[], float] = time.perf_counter,
+        flush_every: int = 16,
+        check_memory: bool = True,
+    ):
+        import jax.numpy as jnp
+
+        from ..models.generate import _decode_twin, cache_template
+
+        cfg = _config or ServingConfig()
+        self.model = model
+        self.params = params
+        self.slots = _resolve(slots, cfg.slots, _ENV_SLOTS, _DEFAULT_SLOTS)
+        self.block_size = _resolve(
+            block_size, cfg.block_size, _ENV_BLOCK_SIZE, _DEFAULT_BLOCK_SIZE
+        )
+        self.max_queue = _resolve(
+            max_queue, cfg.max_queue, _ENV_QUEUE, _DEFAULT_MAX_QUEUE
+        )
+        if self.slots < 1:
+            raise ValueError(f"slots must be >= 1, got {self.slots}")
+        if self.block_size < 1:
+            raise ValueError(
+                f"block_size must be >= 1, got {self.block_size}"
+            )
+        cap = int(max_len) if max_len is not None else int(model.max_len)
+        cap = min(cap, int(model.max_len))
+        self.max_len = (cap // self.block_size) * self.block_size
+        if self.max_len < self.block_size:
+            raise ValueError(
+                f"max_len {cap} is below one block ({self.block_size})"
+            )
+        self.max_blocks_per_seq = self.max_len // self.block_size
+        default_blocks = 1 + self.slots * self.max_blocks_per_seq
+        nb = _resolve(num_blocks, cfg.num_blocks, _ENV_BLOCKS, default_blocks)
+        self.continuous = bool(continuous)
+        self.slo_ttft_s = slo_ttft_s
+        self.slo_token_s = slo_token_s
+        self.flush_every = max(1, int(flush_every))
+        self._registry = registry
+        self._clock = clock
+
+        if not getattr(model, "batched_prefill_safe", False):
+            warnings.warn(
+                "model does not declare batched_prefill_safe: the "
+                "engine's batched prefill can drop over-capacity prompt "
+                "tokens (MoE capacity routing), so continuations may "
+                "differ from generate()'s scan path — prefer ample "
+                "expert capacity when serving such checkpoints",
+                stacklevel=2,
+            )
+        self._twin = _decode_twin(model)
+        head_dim = int(model.d_model) // int(model.num_heads)
+        # The cache template fixes the decode-time dtype and tree shape
+        # (one slot, full table width) — the decode step rebuilds the
+        # flax cache from the pool through it every dispatch.
+        self._tmpl = cache_template(self._twin, 1, self.max_len)
+        dtype = None
+        for path, leaf in self._flat_tmpl():
+            if path[-1].key == "cached_key":
+                dtype = leaf.dtype
+                break
+        self.cache = BlockKVCache(
+            num_layers=int(model.num_layers),
+            num_heads=int(model.num_heads),
+            head_dim=head_dim,
+            num_blocks=nb,
+            block_size=self.block_size,
+            max_blocks_per_seq=self.max_blocks_per_seq,
+            dtype=dtype if dtype is not None else jnp.float32,
+        )
+        if check_memory:
+            fits, detail = self.cache.fits_device()
+            if not fits:
+                raise RuntimeError(
+                    f"KV pool would exhaust device memory ({detail}); "
+                    f"shrink num_blocks/slots or block_size"
+                )
+
+        self._queue: deque[ServingRequest] = deque()
+        self._lock = threading.Lock()
+        self._slots: list[_Slot | None] = [None] * self.slots
+        self._draining = False
+        self._closed = False
+        self._preempted = False
+        self._stop = False
+        self._thread: threading.Thread | None = None
+        self._wake = threading.Event()
+        # The serve thread's terminal exception, if it died (consumers
+        # see their requests rejected with reason="error").
+        self.serve_error: BaseException | None = None
+
+        self._completed = 0
+        self._rejected = 0
+        self._drained = 0
+        self._decode_steps = 0
+        self._tokens = 0
+        self._slo_violations = 0
+        # Registry-counter delta baselines (see _resolve_run).
+        self._counted_steps = 0
+        self._counted_tokens = 0
+
+        self._decode_step = self._build_decode_step()
+        self._prefill_steps: dict[int, Any] = {}
+        mon = self._compile_monitor()
+        if mon is not None:
+            mon.track("serving.decode_step", self._decode_step)
+        self._resolve_run()
+        set_engine(self)
+
+    # -- small helpers -------------------------------------------------
+
+    def _flat_tmpl(self):
+        import jax
+
+        return jax.tree_util.tree_flatten_with_path(self._tmpl)[0]
+
+    @staticmethod
+    def _compile_monitor():
+        from ..telemetry.compileplane import get_compile_monitor
+
+        return get_compile_monitor()
+
+    def _bucket(self, plen: int) -> int:
+        """Prompt lengths round up to a block multiple so prefill
+        compiles a handful of bucket shapes, not one per length."""
+        return blocks_for_tokens(plen, self.block_size) * self.block_size
+
+    # -- compiled steps ------------------------------------------------
+
+    def _build_decode_step(self):
+        """ONE fixed-shape program advancing every slot a token: gather
+        each slot's pool blocks into the contiguous flax cache layout,
+        run the decode twin per slot (vmapped — per-slot cache index),
+        scatter the written position back, argmax the next tokens."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.generate import layer_index
+
+        twin = self._twin
+        tmpl = self._tmpl
+        bs = self.block_size
+        nslots = self.slots
+        t_total = self.max_len
+
+        def one(params_tree, tok, pos, k_sl, v_sl):
+            # k_sl/v_sl: [layers, t_total, heads, head_dim] — this
+            # slot's gathered cache; pos is ITS cache index.
+            def fill(path, leaf):
+                name = path[-1].key
+                if name == "cached_key":
+                    return k_sl[layer_index(path)][None]
+                if name == "cached_value":
+                    return v_sl[layer_index(path)][None]
+                if name == "cache_index":
+                    return pos.astype(leaf.dtype)
+                return jnp.zeros(leaf.shape, leaf.dtype)
+
+            cache = jax.tree_util.tree_map_with_path(fill, tmpl)
+            logits, mut = twin.apply(
+                {"params": params_tree, "cache": cache},
+                tok[None, None], train=False, pos_offset=pos,
+                mutable=["cache"],
+            )
+            knew, vnew = [], []
+            for path, leaf in jax.tree_util.tree_flatten_with_path(
+                mut["cache"]
+            )[0]:
+                name = path[-1].key
+                if name not in ("cached_key", "cached_value"):
+                    continue
+                written = jax.lax.dynamic_slice_in_dim(
+                    leaf[0], pos, 1, axis=0
+                )[0]  # [heads, head_dim]
+                (knew if name == "cached_key" else vnew).append(
+                    (layer_index(path), written)
+                )
+            knew = jnp.stack([w for _, w in sorted(knew, key=lambda t: t[0])])
+            vnew = jnp.stack([w for _, w in sorted(vnew, key=lambda t: t[0])])
+            nxt = jnp.argmax(logits[0, -1], axis=-1).astype(jnp.int32)
+            return nxt, knew, vnew
+
+        def step(params, k_pool, v_pool, tables, positions, tokens):
+            # tables: [slots, max_blocks]; positions/tokens: [slots].
+            k_g = jnp.moveaxis(k_pool[:, tables], 1, 0).reshape(
+                nslots, -1, t_total, k_pool.shape[3], k_pool.shape[4]
+            )
+            v_g = jnp.moveaxis(v_pool[:, tables], 1, 0).reshape(
+                nslots, -1, t_total, v_pool.shape[3], v_pool.shape[4]
+            )
+            nxt, knew, vnew = jax.vmap(
+                one, in_axes=(None, 0, 0, 0, 0)
+            )(params["params"], tokens, positions, k_g, v_g)
+            blk = jnp.take_along_axis(
+                tables, (positions // bs)[:, None], axis=1
+            )[:, 0]
+            off = positions % bs
+            # Idle slots carry all-trash tables, so their writes land in
+            # block 0 — no masking, no shape change.
+            k_pool = k_pool.at[:, blk, off].set(jnp.moveaxis(knew, 0, 1))
+            v_pool = v_pool.at[:, blk, off].set(jnp.moveaxis(vnew, 0, 1))
+            return nxt, k_pool, v_pool
+
+        return jax.jit(step, donate_argnums=(1, 2))
+
+    def _prefill_step(self, bucket: int):
+        """The per-bucket prefill program: one causal forward over the
+        padded prompt, K/V scattered straight into the pool blocks
+        (masked positions land in the trash block), first generated
+        token argmax'd from the last real position's logits."""
+        fn = self._prefill_steps.get(bucket)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.generate import prefill_kv
+
+        model = self.model
+        bs = self.block_size
+
+        def prefill(params, k_pool, v_pool, tokens, length, table):
+            # tokens: [bucket]; length: true prompt length; table: [MB].
+            k, v, logits = prefill_kv(model, params, tokens[None])
+            k = k[:, 0]  # [layers, bucket, heads, head_dim]
+            v = v[:, 0]
+            pos = jnp.arange(tokens.shape[0])
+            blk = jnp.where(
+                pos < length, table[pos // bs], jnp.int32(TRASH_BLOCK)
+            )
+            off = pos % bs
+            k_pool = k_pool.at[:, blk, off].set(k.astype(k_pool.dtype))
+            v_pool = v_pool.at[:, blk, off].set(v.astype(v_pool.dtype))
+            last = jax.lax.dynamic_index_in_dim(
+                logits[0], length - 1, axis=0, keepdims=False
+            )
+            first = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            return first, k_pool, v_pool
+
+        fn = jax.jit(prefill, donate_argnums=(1, 2))
+        self._prefill_steps[bucket] = fn
+        mon = self._compile_monitor()
+        if mon is not None:
+            mon.track(f"serving.prefill_{bucket}", fn)
+        return fn
+
+    def warmup(self, prompt_lengths: tuple[int, ...] = ()) -> None:
+        """Compile the decode step and the prefill buckets covering
+        ``prompt_lengths`` before traffic arrives. All warmup writes
+        target the trash block, so the pool and allocator are untouched
+        — but the dispatches DONATE the pool buffers, so warmup must
+        not race the serve thread (same single-driver rule as
+        :meth:`run`): call it before :meth:`start`, or :meth:`stop`
+        first."""
+        if self._thread is not None and self._thread.is_alive():
+            raise RuntimeError(
+                "engine is serving on its background thread; warmup "
+                "dispatches donate the KV pools and would race it — "
+                "stop() first (new prefill buckets also compile "
+                "on-demand at admission)"
+            )
+        import jax.numpy as jnp
+
+        buckets = {self._bucket(max(1, int(p))) for p in prompt_lengths}
+        buckets.add(self.block_size)
+        mb = self.max_blocks_per_seq
+        trash_table = jnp.zeros((mb,), jnp.int32)
+        for bucket in sorted(buckets):
+            fn = self._prefill_step(bucket)
+            _, self.cache.k_pool, self.cache.v_pool = fn(
+                self.params, self.cache.k_pool, self.cache.v_pool,
+                jnp.zeros((bucket,), jnp.int32), jnp.int32(1), trash_table,
+            )
+        nxt, self.cache.k_pool, self.cache.v_pool = self._decode_step(
+            self.params, self.cache.k_pool, self.cache.v_pool,
+            jnp.zeros((self.slots, mb), jnp.int32),
+            jnp.zeros((self.slots,), jnp.int32),
+            jnp.zeros((self.slots,), jnp.int32),
+        )
+        np.asarray(nxt)  # block until the compile settles
+
+    # -- admission -----------------------------------------------------
+
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: int,
+        *,
+        eos_token: int | None = None,
+        on_token: Callable[[int], None] | None = None,
+    ) -> ServingRequest:
+        """Queue a generation request; returns its handle immediately.
+
+        Admission control is token-budget based: a request whose
+        worst-case KV footprint can NEVER fit the pool raises
+        ``ValueError`` (a sizing error, not load); a full queue or a
+        draining engine **rejects** — the returned handle is already
+        finished with ``status == "rejected"`` and the reason, and
+        ``serving.admission_rejects`` counts it. Otherwise the request
+        waits for a free batch slot + free blocks and joins the decode
+        batch between iterations.
+        """
+        from .. import faults
+
+        if faults.ARMED:
+            faults.check("serving.admit")
+        req = ServingRequest(
+            prompt, max_new_tokens, eos_token=eos_token,
+            on_token=on_token, clock=self._clock,
+        )
+        plen = int(req.prompt.shape[0])
+        if plen < 1:
+            raise ValueError("prompt must hold at least one token")
+        if req.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {req.max_new_tokens}"
+            )
+        total = plen + req.max_new_tokens
+        if total > self.max_len:
+            raise ValueError(
+                f"prompt + max_new_tokens = {total} exceeds the engine's "
+                f"max_len {self.max_len}"
+            )
+        if req.eos_token is not None and not (
+            0 <= int(req.eos_token) < int(self.model.vocab_size)
+        ):
+            raise ValueError(
+                f"eos_token {req.eos_token} outside the vocabulary "
+                f"[0, {self.model.vocab_size})"
+            )
+        if self.cache.blocks_for(total) > self.cache.num_blocks - 1:
+            raise ValueError(
+                f"request needs {self.cache.blocks_for(total)} blocks but "
+                f"the pool only holds {self.cache.num_blocks - 1}"
+            )
+        with self._lock:
+            # _stop (a merely-parked engine between stop() and the next
+            # run()/start()) does NOT reject: submissions queue and the
+            # next driver serves them. Only a drain or teardown sheds.
+            if self._draining or self._closed:
+                self._reject(
+                    req, "draining" if self._draining else "shutdown"
+                )
+                return req
+            if len(self._queue) >= self.max_queue:
+                self._reject(req, "queue_full")
+                return req
+            self._queue.append(req)
+        self._wake.set()
+        return req
+
+    def _reject(self, req: ServingRequest, reason: str) -> None:
+        self._rejected += 1
+        req._finish(REJECTED, reason)
+        reg = self._live_registry()
+        if getattr(reg, "enabled", True):
+            reg.counter("serving.admission_rejects", reason=reason).inc()
+
+    def _live_registry(self) -> MetricsRegistry:
+        return self._registry if self._registry is not None else get_registry()
+
+    def _admit_phase(self) -> int:
+        """Move queued requests into free batch slots (continuous mode:
+        between any two iterations; static mode: only once every slot
+        has drained), prefilling each admission. FIFO — a head request
+        waiting on blocks holds the line (documented in
+        docs/serving.md)."""
+        if not self.continuous and any(s is not None for s in self._slots):
+            return 0
+        admitted = 0
+        while True:
+            free_ix = next(
+                (i for i, s in enumerate(self._slots) if s is None), None
+            )
+            if free_ix is None:
+                break
+            with self._lock:
+                if not self._queue:
+                    break
+                head = self._queue[0]
+                total = int(head.prompt.shape[0]) + head.max_new_tokens
+                if not self.cache.can_alloc(total):
+                    break
+                self._queue.popleft()
+            self._admit(head, free_ix, total)
+            admitted += 1
+        return admitted
+
+    def _admit(self, req: ServingRequest, slot_ix: int, total: int) -> None:
+        import jax.numpy as jnp
+
+        req.admitted_t = self._clock()
+        req.status = ACTIVE
+        blocks = self.cache.alloc(total)
+        table = self.cache.table_row(blocks)
+        slot = _Slot(req, blocks, table)
+        plen = int(req.prompt.shape[0])
+        bucket = self._bucket(plen)
+        padded = np.zeros((bucket,), np.int32)
+        padded[:plen] = req.prompt
+        fn = self._prefill_step(bucket)
+        first, self.cache.k_pool, self.cache.v_pool = fn(
+            self.params, self.cache.k_pool, self.cache.v_pool,
+            jnp.asarray(padded), jnp.int32(plen), jnp.asarray(table),
+        )
+        slot.position = plen
+        slot.generated = 1
+        slot.last_token = int(first)
+        self._slots[slot_ix] = slot
+        req._deliver(slot.last_token)
+        self._tokens += 1
+        if self._record:
+            reg = self._reg
+            if req.queue_wait_s is not None:
+                reg.histogram("serving.queue_wait_seconds").observe(
+                    req.queue_wait_s
+                )
+        if slot.generated >= req.max_new_tokens or (
+            req.eos_token is not None and slot.last_token == int(req.eos_token)
+        ):
+            self._evict(slot_ix)
+
+    # -- decode --------------------------------------------------------
+
+    def _decode_tick(self) -> None:
+        """One engine iteration's decode phase: a single dispatch over
+        every slot, then host-side delivery/eviction."""
+        import jax.numpy as jnp
+
+        from .. import faults
+
+        if faults.ARMED:
+            faults.check("serving.decode")
+        mb = self.max_blocks_per_seq
+        tables = np.zeros((self.slots, mb), np.int32)
+        positions = np.zeros((self.slots,), np.int32)
+        tokens = np.zeros((self.slots,), np.int32)
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            tables[i] = slot.table
+            positions[i] = slot.position
+            tokens[i] = slot.last_token
+        nxt, self.cache.k_pool, self.cache.v_pool = self._decode_step(
+            self.params, self.cache.k_pool, self.cache.v_pool,
+            jnp.asarray(tables), jnp.asarray(positions), jnp.asarray(tokens),
+        )
+        nxt = np.asarray(nxt)
+        self._decode_steps += 1
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            tok = int(nxt[i])
+            slot.position += 1
+            slot.generated += 1
+            slot.last_token = tok
+            slot.req._deliver(tok)
+            self._tokens += 1
+            if slot.generated >= slot.req.max_new_tokens or (
+                slot.req.eos_token is not None
+                and tok == int(slot.req.eos_token)
+            ):
+                self._evict(i)
+
+    def _evict(self, slot_ix: int) -> None:
+        """Finish a slot's request and return its blocks to the free
+        list — the eviction half of the paged-cache contract."""
+        slot = self._slots[slot_ix]
+        assert slot is not None
+        self._slots[slot_ix] = None
+        self.cache.free(slot.blocks)
+        req = slot.req
+        req._finish(FINISHED)
+        self._completed += 1
+        violations = []
+        if self.slo_ttft_s is not None and (
+            req.ttft_s is not None and req.ttft_s > self.slo_ttft_s
+        ):
+            violations.append("ttft")
+        if self.slo_token_s is not None and (
+            req.per_token_s is not None
+            and req.per_token_s > self.slo_token_s
+        ):
+            violations.append("per_token")
+        self._slo_violations += len(violations)
+        if self._record:
+            reg = self._reg
+            if req.ttft_s is not None:
+                reg.histogram("serving.ttft_seconds").observe(req.ttft_s)
+            if req.per_token_s is not None:
+                reg.histogram("serving.token_seconds").observe(
+                    req.per_token_s
+                )
+            reg.counter("serving.requests_completed").inc()
+            for kind in violations:
+                reg.counter("serving.slo_violations", kind=kind).inc()
+
+    # -- the loop ------------------------------------------------------
+
+    @property
+    def active_count(self) -> int:
+        return sum(1 for s in self._slots if s is not None)
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def _begin_drain(self, *, preempted: bool) -> None:
+        """Stop admitting: queued requests are rejected, active slots
+        decode to completion (the SIGTERM grace-window contract —
+        in-flight work finishes, nothing new starts)."""
+        with self._lock:
+            self._draining = True
+            self._preempted = self._preempted or preempted
+            dropped = list(self._queue)
+            self._queue.clear()
+        self._drained += self.active_count
+        for req in dropped:
+            self._reject(req, "preempted" if preempted else "draining")
+
+    def _iteration(self) -> bool:
+        """One scheduler iteration: preemption poll → admissions →
+        decode tick → liveness/metrics. Returns whether any work
+        happened."""
+        from ..runtime import preemption_requested
+        from ..telemetry.watchdog import notify_progress
+
+        if preemption_requested() and not self._draining:
+            self._begin_drain(preempted=True)
+        admitted = self._admit_phase()
+        ticked = False
+        if any(s is not None for s in self._slots):
+            self._decode_tick()
+            ticked = True
+        if admitted or ticked:
+            # Progress ONLY when work happened: an idle serve thread
+            # bumping the process-global watchdog counter every poll
+            # would mask a co-resident train loop's stall from the
+            # watchdog and /healthz (idle != progress).
+            notify_progress(1)
+        if admitted or (
+            ticked and self._decode_steps % self.flush_every == 0
+        ):
+            self._observe(phase="running")
+        return bool(admitted) or ticked
+
+    def _observe(self, phase: str) -> None:
+        """Refresh the gauges + the exporter status board (resolved once
+        per run — never on the fully-off path)."""
+        if self._record:
+            reg = self._reg
+            reg.gauge("serving.queue_depth").set(self.queue_depth)
+            reg.gauge("serving.active_sequences").set(self.active_count)
+            reg.gauge("serving.kv_blocks_in_use").set(self.cache.used_blocks)
+            reg.gauge("serving.kv_blocks_free").set(self.cache.free_blocks)
+            reg.counter("serving.decode_steps").inc(
+                self._decode_steps - self._counted_steps
+            )
+            reg.counter("serving.tokens_generated").inc(
+                self._tokens - self._counted_tokens
+            )
+            self._counted_steps = self._decode_steps
+            self._counted_tokens = self._tokens
+        if self._exporter is not None:
+            total = self.cache.num_blocks - 1
+            self._exporter.note_serving(
+                phase=phase,
+                continuous=self.continuous,
+                slots=self.slots,
+                active=self.active_count,
+                queued=self.queue_depth,
+                completed=self._completed,
+                rejected=self._rejected,
+                drained=self._drained,
+                decode_steps=self._decode_steps,
+                tokens=self._tokens,
+                kv_blocks_in_use=self.cache.used_blocks,
+                kv_blocks_total=total,
+                kv_util=(self.cache.used_blocks / total) if total else 0.0,
+                slo_violations=self._slo_violations,
+            )
+
+    def _resolve_run(self) -> None:
+        """The once-per-run resolution of every observability surface
+        the loop touches (the PR 4 zero-cost contract: fully off, the
+        per-iteration path reads two booleans)."""
+        from ..telemetry.export import get_exporter
+
+        self._reg = self._live_registry()
+        self._record = bool(getattr(self._reg, "enabled", True))
+        self._exporter = get_exporter()
+        # NOTE: the _counted_* delta baselines are NOT reset here — they
+        # live for the engine's lifetime (set once in __init__), so
+        # ticks that happened between the last _observe and a driver
+        # switch still reach the cumulative registry counters at the
+        # next flush instead of being silently dropped.
+
+    def drain(self) -> None:
+        """Graceful wind-down without a signal: stop admitting (queued
+        requests rejected), let active slots decode to completion on the
+        next :meth:`run` / serve iterations."""
+        if not self._draining:
+            self._begin_drain(preempted=False)
+
+    def step(self) -> bool:
+        """Run ONE scheduler iteration inline (test/tooling hook);
+        returns whether any work happened."""
+        return self._iteration()
+
+    def run(self) -> dict[str, Any]:
+        """Drive the engine until queue and slots drain (or a
+        preemption drain completes); returns the run summary. The
+        blocking, host-driven serving loop — the serving counterpart of
+        ``train_loop``."""
+        if self._thread is not None and self._thread.is_alive():
+            raise RuntimeError(
+                "engine is already serving on its background thread; "
+                "stop() it before driving run() inline"
+            )
+        # A previous stop() parked the engine (_stop gates submit);
+        # driving it inline un-parks it — stop()-then-run() is the
+        # documented sequence for switching drivers.
+        self._stop = False
+        self._resolve_run()
+        t0 = self._clock()
+        tokens0 = self._tokens
+        self._observe(phase="running")
+        while True:
+            worked = self._iteration()
+            if not worked and self.active_count == 0 and (
+                self.queue_depth == 0 or self._draining
+            ):
+                break
+        return self._finish_run(t0, tokens0)
+
+    def _finish_run(self, t0: float, tokens0: int) -> dict[str, Any]:
+        wall = self._clock() - t0
+        phase = "preempted" if self._preempted else "finished"
+        self._observe(phase=phase)
+        reg = self._reg
+        if self._record and reg.sinks:
+            reg.flush()
+        summary = {
+            "completed": self._completed,
+            "rejected": self._rejected,
+            "drained": self._drained,
+            "preempted": self._preempted,
+            "decode_steps": self._decode_steps,
+            "tokens": self._tokens,
+            "slo_violations": self._slo_violations,
+            "wall_seconds": wall,
+            # Rate = THIS run's tokens over THIS run's wall — the other
+            # counters are engine-lifetime totals, and dividing a
+            # lifetime count by one run's wall would inflate the rate
+            # after a driver switch (background serve, then run()).
+            "tokens_per_sec": (
+                (self._tokens - tokens0) / wall if wall > 0 else 0.0
+            ),
+        }
+        return summary
+
+    # -- background serving -------------------------------------------
+
+    def _fail_pending(self, reason: str, *, include_active: bool) -> None:
+        """Reject everything still pending (error/shutdown paths),
+        counted through the same :meth:`_reject` accounting as every
+        other rejection; evicted slots return their blocks."""
+        with self._lock:
+            pending = list(self._queue)
+            self._queue.clear()
+        for req in pending:
+            self._reject(req, reason)
+        if include_active:
+            for i, slot in enumerate(self._slots):
+                if slot is not None:
+                    self._slots[i] = None
+                    self.cache.free(slot.blocks)
+                    self._reject(slot.req, reason)
+
+    def start(self) -> "InferenceEngine":
+        """Serve on a background thread until :meth:`stop`: the loop
+        sleeps on an event when idle and wakes on :meth:`submit` — the
+        streaming-consumer spelling (``req.stream()`` on the caller's
+        thread)."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop = False
+        self.serve_error = None
+        self._resolve_run()
+
+        def serve() -> None:
+            while not self._stop:
+                try:
+                    worked = self._iteration()
+                except BaseException as exc:
+                    # A dying serve thread must not strand consumers
+                    # blocked in wait()/stream(): bank the error, fail
+                    # every pending request (reason="error" — their
+                    # handles unblock and report it), and exit.
+                    self.serve_error = exc
+                    warnings.warn(
+                        f"serving loop failed: {exc!r}; pending requests "
+                        f"rejected (reason='error')",
+                        stacklevel=2,
+                    )
+                    self._fail_pending("error", include_active=True)
+                    return
+                if not worked and self.active_count == 0:
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
+
+        self._thread = threading.Thread(
+            target=serve, name="fluxmpi-serving", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 30.0) -> bool:
+        """Stop the background serving thread (idempotent); returns
+        whether it fully stopped. Queued and active requests are NOT
+        completed — use a preemption drain (``request_preemption()``)
+        for a graceful wind-down. A thread that outlives ``timeout``
+        (wedged in a dispatch or a chaos ``delay=`` stall) keeps its
+        reference — a later :meth:`stop`/:meth:`close` retries — so
+        teardown never frees state a live thread still touches."""
+        self._stop = True
+        self._wake.set()
+        thread = self._thread
+        if thread is None:
+            return True
+        thread.join(timeout=timeout)
+        if thread.is_alive():
+            warnings.warn(
+                f"serving thread still running after {timeout}s "
+                f"(wedged dispatch?); its state is left untouched",
+                stacklevel=2,
+            )
+            return False
+        self._thread = None
+        return True
+
+    def close(self) -> None:
+        """Full teardown: stop the serve thread, fail anything still
+        pending, release every block, drop the device pools, and
+        deregister. ``telemetry.shutdown()``'s reset path. If the serve
+        thread cannot be joined, active slots and the pools are left in
+        place (leak over corruption — a resuming thread must never
+        double-free blocks or decode into re-zeroed pools)."""
+        self._closed = True  # submits from here on reject ("shutdown")
+        stopped = self.stop()
+        self._fail_pending("shutdown", include_active=stopped)
+        if stopped:
+            self.cache.drop_pools()
+        if get_engine() is self:
+            set_engine(None)

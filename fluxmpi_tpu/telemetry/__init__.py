@@ -265,9 +265,11 @@ def configure(spec: Any = None) -> MetricsRegistry:
 
 
 def shutdown() -> None:
-    """Tear down the observability planes in failure-safe order: stop
-    the live exporter FIRST (socket closed, serving thread joined — the
-    port is immediately rebindable, and no scrape ever observes a
+    """Tear down the observability planes in failure-safe order: reset
+    the serving plane FIRST (inference engine stopped, pending requests
+    failed, KV pools dropped — it produces into every surface below),
+    then stop the live exporter (socket closed, serving thread joined —
+    the port is immediately rebindable, and no scrape ever observes a
     half-reset process), disarm the watchdog, export the trace ring
     (when a path was configured) then reset the tracer and the flight
     recorder ring, reset the run-health plane (goodput window + anomaly
@@ -276,6 +278,14 @@ def shutdown() -> None:
     cycle), then flush and detach every sink on the default registry
     (instruments survive — a re-configured registry keeps its cumulative
     counters)."""
+    try:
+        # Lazy import: the serving plane needs jax; this package must
+        # stay importable without it (same rule as the auto-profiler).
+        from ..serving import shutdown as _serving_shutdown
+
+        _serving_shutdown()
+    except Exception:
+        pass
     try:
         export.shutdown()
     except Exception:

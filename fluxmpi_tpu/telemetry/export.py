@@ -339,6 +339,7 @@ class Exporter:
         self._server: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
         self._status: dict[str, Any] = {}
+        self._serving: dict[str, Any] = {}
         self._status_lock = threading.Lock()
         # Progress plateau tracking (the watchdog's check() shape,
         # evaluated lazily per health request instead of on a poll
@@ -422,9 +423,21 @@ class Exporter:
             self._status.update(fields)
             self._status["noted_unix"] = time.time()
 
+    def note_serving(self, **fields: Any) -> None:
+        """Merge ``fields`` into the ``serving`` section of ``/status``
+        — the inference engine's board (active/queued requests, decode
+        step counter, KV block occupancy, SLO violations), posted at
+        its admission/flush boundaries the way ``train_loop`` posts the
+        ``train`` board. ``scripts/fluxmpi_top.py`` renders it as the
+        serving view."""
+        with self._status_lock:
+            self._serving.update(fields)
+            self._serving["noted_unix"] = time.time()
+
     def clear_status(self) -> None:
         with self._status_lock:
             self._status.clear()
+            self._serving.clear()
 
     # -- health --------------------------------------------------------
 
@@ -517,6 +530,7 @@ class Exporter:
 
         with self._status_lock:
             train = dict(self._status)
+            serving = dict(self._serving) or None
         gp = _goodput.get_goodput_tracker()
         goodput_rep = gp.report() if gp.enabled else None
         det = _anomaly.get_anomaly_detector()
@@ -546,6 +560,7 @@ class Exporter:
             "process": _process_index(),
             "process_count": process_count,
             "train": train,
+            "serving": serving,
             "goodput": goodput_rep,
             "anomaly": last_anomaly,
             "monitor": monitor,

@@ -147,6 +147,24 @@ KNOWN_METRIC_NAMES = frozenset(
         # itself would lie).
         "export.requests",
         "export.render_seconds",
+        # Serving plane (PR 13): the continuous-batching inference
+        # engine's request/latency/cache accounting — queue depth and
+        # active batch slots (gauges), TTFT / mean-per-token / queue-wait
+        # latency histograms, admission rejects ({reason=...}), SLO
+        # breaches ({kind=ttft|per_token}), cumulative decode dispatches
+        # and generated tokens, and the paged KV pool's block occupancy.
+        "serving.queue_depth",
+        "serving.active_sequences",
+        "serving.ttft_seconds",
+        "serving.token_seconds",
+        "serving.queue_wait_seconds",
+        "serving.admission_rejects",
+        "serving.slo_violations",
+        "serving.requests_completed",
+        "serving.decode_steps",
+        "serving.tokens_generated",
+        "serving.kv_blocks_in_use",
+        "serving.kv_blocks_free",
     }
 )
 
@@ -158,6 +176,7 @@ _CLOSED_NAMESPACES = (
     "compile.",
     "memory.",
     "export.",
+    "serving.",
 )
 
 # The preemption trace event train_loop emits when it drains and exits on
@@ -206,6 +225,11 @@ _BENCH_OPTIONAL: dict[str, tuple[type, ...]] = {
     # one-dispatch-per-window claim is asserted in the record rather
     # than inferred.
     "fused_window": (dict,),
+    # Serving A/B (PR 13): static-batch vs continuous-batch legs on the
+    # mixed-length workload, the speedup, and the steady-state retrace
+    # count across mid-flight joins (must be 0 — the zero-retrace
+    # claim, asserted by tests/test_bench.py's smoke).
+    "serving": (dict,),
 }
 
 
@@ -333,7 +357,7 @@ def validate_status_record(rec: object) -> list[str]:
     for key in ("train", "monitor", "watchdog"):
         if not isinstance(rec.get(key), dict):
             errors.append(f"'{key}' must be an object")
-    for key in ("goodput", "anomaly"):
+    for key in ("goodput", "anomaly", "serving"):
         v = rec.get(key)
         if v is not None and not isinstance(v, dict):
             errors.append(f"'{key}' must be null or an object")

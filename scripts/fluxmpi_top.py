@@ -15,6 +15,12 @@ anomaly ticker of the most recent triggers fleet-wide:
     tpu-host-1       9600     80.9  0.0312    90.8%  0.409    2.3s  ok
     anomalies: (none)
 
+Hosts running the serving plane (``fluxmpi_tpu.serving``) additionally
+get a SERVING block — active/queued requests, live decode step rate,
+token counter, KV block utilization, completions/rejects, and an
+SLO-violation ticker — rendered from the ``serving`` section of the
+same ``/status`` snapshot.
+
 Targets are ``host``, ``host:port`` (default port 9307), or full URLs.
 ``--jsonl FILE...`` is the fallback for runs without an exporter: the
 same view re-derived from the growing telemetry JSONL bank (last record
@@ -208,6 +214,55 @@ def _row(
     )
 
 
+def _serving_rows(
+    statuses: dict[str, dict[str, Any] | None],
+    rates: dict[str, tuple[float, float]],
+) -> list[str]:
+    """The serving view: one row per host that carries a ``serving``
+    board (the continuous-batching inference engine posts it to
+    ``/status``) — active/queued requests, live decode step rate from
+    counter deltas, KV block utilization, token and SLO counters — plus
+    an SLO-violation ticker."""
+    rows: list[str] = []
+    tickers: list[str] = []
+    now = time.time()
+    for name, status in statuses.items():
+        srv = (status or {}).get("serving")
+        if not isinstance(srv, dict):
+            continue
+        if not rows:
+            rows.append(
+                f"{'SERVING':<18}{'ACT':>5} {'QUEUED':>7} {'STEP/S':>7} "
+                f"{'TOKENS':>8} {'KV USE':>7} {'DONE':>6} {'REJ':>5}  PHASE"
+            )
+        steps = srv.get("decode_steps")
+        rate = None
+        if isinstance(steps, (int, float)):
+            prev = rates.get(name + "#serving")
+            if prev is not None and now > prev[0] and steps >= prev[1]:
+                rate = (steps - prev[1]) / (now - prev[0])
+            rates[name + "#serving"] = (now, float(steps))
+        util = srv.get("kv_util")
+        rows.append(
+            f"{name:<18}"
+            f"{_fmt(srv.get('active'), '>5.0f'):>5} "
+            f"{_fmt(srv.get('queued'), '>7.0f'):>7} "
+            f"{_fmt(rate, '>7.1f'):>7} "
+            f"{_fmt(srv.get('tokens'), '>8.0f'):>8} "
+            f"{_fmt(100 * util if util is not None else None, '>6.1f'):>6}% "
+            f"{_fmt(srv.get('completed'), '>6.0f'):>6} "
+            f"{_fmt(srv.get('rejected'), '>5.0f'):>5}  "
+            f"{srv.get('phase', '?')}"
+        )
+        slo = srv.get("slo_violations")
+        if isinstance(slo, (int, float)) and slo > 0:
+            tickers.append(f"  {name}: {int(slo)} SLO violation(s)")
+    if rows:
+        rows.append("slo:" + (" (none)" if not tickers else ""))
+        rows.extend(tickers)
+    return rows
+
+
 def render_frame(
     statuses: dict[str, dict[str, Any] | None],
     rates: dict[str, tuple[float, float]],
@@ -248,6 +303,7 @@ def render_frame(
             )
     lines.append("anomalies:" + (" (none)" if not tickers else ""))
     lines.extend(tickers)
+    lines.extend(_serving_rows(statuses, rates))
     return "\n".join(lines)
 
 

@@ -302,6 +302,52 @@ def test_bench_smoke_mode_emits_schema_valid_json(tmp_path):
     assert check.returncode == 0, check.stdout + check.stderr
 
 
+def test_bench_serving_ab_smoke(tmp_path):
+    """The serving child's tier-1 smoke (FLUXMPI_TPU_BENCH_SMOKE=1 +
+    _CONFIG=serving): static-batch vs continuous-batch A/B on the
+    mixed-length workload. The acceptance claims are asserted in the
+    record itself — continuous batching beats static on total token
+    throughput (>= 1.5x on the CPU smoke) over the SAME token count,
+    and mid-flight joins cost zero steady-state retraces."""
+    import os
+    import subprocess
+    import sys
+
+    here = os.path.dirname(os.path.abspath(bench.__file__))
+    env = {
+        **os.environ,
+        "FLUXMPI_TPU_BENCH_SMOKE": "1",
+        "FLUXMPI_TPU_BENCH_CONFIG": "serving",
+    }
+    proc = subprocess.run(
+        [sys.executable, os.path.join(here, "bench.py")],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=here,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    result = bench._parse_json_line(proc.stdout)
+    assert result is not None and result["metric"] == "serving_tokens_per_sec", (
+        proc.stderr[-2000:]
+    )
+    assert result.get("smoke") == 1
+    ab = result["serving"]
+    assert ab["static"]["tokens"] == ab["continuous"]["tokens"] > 0
+    assert ab["speedup"] >= 1.5, ab
+    assert ab["continuous"]["decode_steps"] < ab["static"]["decode_steps"]
+    assert ab["steady_retraces"] == 0
+    json_path = tmp_path / "serving.json"
+    json_path.write_text(json.dumps(result))
+    check = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(here, "scripts", "check_metrics_schema.py"),
+            str(json_path),
+        ],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert check.returncode == 0, check.stdout + check.stderr
+
+
 @pytest.mark.slow
 def test_bench_smoke_mode_full_with_scaling(tmp_path):
     """Full smoke including the dp1/dpN scaling pair + breakdown."""

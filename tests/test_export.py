@@ -535,6 +535,29 @@ def _check_exporter():
     assert export.get_exporter() is None
 
 
+def _arm_serving(tmp_path):
+    from fluxmpi_tpu import serving
+
+    serving.configure(True)
+
+    class _StubEngine:
+        closed = False
+
+        def close(self):
+            self.closed = True
+
+    _arm_serving.engine = _StubEngine()
+    serving.set_engine(_arm_serving.engine)
+
+
+def _check_serving():
+    from fluxmpi_tpu import serving
+
+    assert serving.get_engine() is None
+    assert not serving.enabled()
+    assert _arm_serving.engine.closed
+
+
 _PLANES = [
     ("registry", _arm_registry, _check_registry),
     ("tracer", _arm_tracer, _check_tracer),
@@ -546,6 +569,7 @@ _PLANES = [
     ("memory", _arm_memory, _check_memory),
     ("profiler", _arm_profiler, _check_profiler),
     ("exporter", _arm_exporter, _check_exporter),
+    ("serving", _arm_serving, _check_serving),
 ]
 
 

@@ -560,6 +560,105 @@ def test_generate_greedy_matches_naive_loop(world):
     np.testing.assert_array_equal(np.asarray(out), naive)
 
 
+def test_batched_prefill_bit_identical_to_scan(world):
+    """The batched-prefill fast path (one causal forward populates the
+    KV caches) is bit-for-bit equivalent to the one-token-per-tick scan
+    prefill for greedy decoding — and, because the rng stream advances
+    identically, for sampled and eos-absorbed decoding too."""
+    from fluxmpi_tpu.models import TransformerLM, generate
+
+    lm = TransformerLM(vocab_size=32, max_len=32, num_layers=2, d_model=32,
+                       num_heads=4, d_ff=64)
+    rng = np.random.default_rng(3)
+    variables = lm.init(jax.random.PRNGKey(0), jnp.zeros((2, 4), jnp.int32),
+                        train=False)
+    for plen in (1, 2, 7):
+        prompt = jnp.asarray(
+            rng.integers(0, 32, size=(2, plen)).astype(np.int32)
+        )
+        greedy_scan = generate(lm, variables, prompt, 8, prefill="scan")
+        greedy_batched = generate(lm, variables, prompt, 8)
+        np.testing.assert_array_equal(
+            np.asarray(greedy_scan), np.asarray(greedy_batched)
+        )
+        key = jax.random.PRNGKey(plen)
+        s_scan = generate(lm, variables, prompt, 8, temperature=1.0,
+                          top_k=5, rng=key, prefill="scan")
+        s_batched = generate(lm, variables, prompt, 8, temperature=1.0,
+                             top_k=5, rng=key, prefill="batched")
+        np.testing.assert_array_equal(np.asarray(s_scan), np.asarray(s_batched))
+        e_scan = generate(lm, variables, prompt, 8, eos_token=3,
+                          prefill="scan")
+        e_batched = generate(lm, variables, prompt, 8, eos_token=3)
+        np.testing.assert_array_equal(np.asarray(e_scan), np.asarray(e_batched))
+    with pytest.raises(ValueError, match="prefill"):
+        generate(lm, variables, prompt, 4, prefill="bogus")
+
+
+def test_moe_generate_auto_prefill_keeps_scan_path(world):
+    """prefill="auto" must NOT silently switch MoE models to the
+    batched prompt forward: capacity routing can drop over-capacity
+    prompt tokens there that the one-token-per-tick scan never drops,
+    changing outputs. auto == scan for MoE, bit-for-bit."""
+    from fluxmpi_tpu.models import MoETransformerLM, TransformerLM, generate
+
+    assert TransformerLM.batched_prefill_safe is True
+    assert MoETransformerLM.batched_prefill_safe is False
+    lm = MoETransformerLM(vocab_size=32, max_len=24, num_layers=2,
+                          d_model=32, num_heads=4, d_ff=64,
+                          num_experts=2, capacity_factor=1.0)
+    rng = np.random.default_rng(2)
+    prompt = jnp.asarray(rng.integers(0, 32, size=(2, 6)).astype(np.int32))
+    variables = lm.init(jax.random.PRNGKey(0), prompt, train=False)
+    auto = generate(lm, variables, prompt, 6)
+    scan = generate(lm, variables, prompt, 6, prefill="scan")
+    np.testing.assert_array_equal(np.asarray(auto), np.asarray(scan))
+
+
+def test_prefill_kv_matches_scan_warmed_cache(world):
+    """prefill_kv/prefill_cache produce the cache state the scan would
+    reach: K/V for every prompt position (float-close — the batched and
+    single-query attends reduce in different orders) with cache_index
+    advanced past the prompt."""
+    from fluxmpi_tpu.models import TransformerLM
+    from fluxmpi_tpu.models.generate import (
+        _decode_twin, _sized_cache, prefill_cache, prefill_kv,
+    )
+
+    lm = TransformerLM(vocab_size=32, max_len=24, num_layers=2, d_model=32,
+                       num_heads=4, d_ff=64)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, 32, size=(2, 6)).astype(np.int32))
+    variables = lm.init(jax.random.PRNGKey(0), prompt, train=False)
+
+    k, v, logits = prefill_kv(lm, variables, prompt)
+    assert k.shape == (2, 2, 6, 4, 8)  # [layers, batch, plen, heads, hd]
+    assert logits.shape == (2, 6, 32)
+
+    twin = _decode_twin(lm)
+    scan_cache = _sized_cache(twin, 2, 12)
+    for pos in range(6):
+        _, mut = twin.apply(
+            {"params": variables["params"], "cache": scan_cache},
+            prompt[:, pos:pos + 1], train=False, pos_offset=pos,
+            mutable=["cache"],
+        )
+        scan_cache = mut["cache"]
+    batched_cache, last = prefill_cache(lm, variables, prompt, 12)
+    flat_scan = jax.tree_util.tree_leaves(scan_cache)
+    flat_batched = jax.tree_util.tree_leaves(batched_cache)
+    for a, b in zip(flat_scan, flat_batched):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            atol=2e-5, rtol=1e-4,
+        )
+    full = lm.apply(variables, prompt, train=False)
+    np.testing.assert_array_equal(
+        np.asarray(jnp.argmax(last, -1)),
+        np.asarray(jnp.argmax(full[:, -1], -1)),
+    )
+
+
 def test_generate_sampling_and_validation(world):
     from fluxmpi_tpu.models import TransformerLM, generate
 

@@ -1,0 +1,676 @@
+"""Serving plane tests: the paged KV cache's free-list round trip,
+token-budget admission control, continuous batching's bit-identity with
+``generate()``, zero-retrace mid-flight joins, streaming delivery,
+preemption draining under load, the ``serving.admit``/``serving.decode``
+fault sites, and the telemetry/status wiring."""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import fluxmpi_tpu as fm
+from fluxmpi_tpu import faults, runtime, serving
+from fluxmpi_tpu.errors import FaultInjectedError
+from fluxmpi_tpu.models import TransformerLM
+from fluxmpi_tpu.models.generate import generate
+from fluxmpi_tpu.serving import BlockKVCache, InferenceEngine, blocks_for_tokens
+from fluxmpi_tpu.telemetry import Exporter, export, get_registry
+from fluxmpi_tpu.telemetry import compileplane
+from fluxmpi_tpu.telemetry.schema import (
+    KNOWN_METRIC_NAMES,
+    validate_metric,
+    validate_record,
+    validate_status_record,
+)
+
+
+@pytest.fixture(scope="module")
+def model(world):
+    lm = TransformerLM(vocab_size=32, max_len=64, num_layers=2, d_model=32,
+                       num_heads=4, d_ff=64)
+    variables = lm.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32), train=False
+    )
+    return lm, variables
+
+
+@pytest.fixture()
+def engine_factory(model):
+    lm, variables = model
+    built = []
+
+    def make(**kwargs):
+        kwargs.setdefault("slots", 2)
+        kwargs.setdefault("block_size", 8)
+        eng = InferenceEngine(lm, variables, **kwargs)
+        built.append(eng)
+        return eng
+
+    yield make
+    for eng in built:
+        eng.close()
+    serving.shutdown()
+    runtime.clear_preemption()
+    get_registry().reset()
+
+
+def _prompt(rng, n):
+    return rng.integers(0, 32, size=(n,)).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# Block cache / free-list allocator
+# ---------------------------------------------------------------------------
+
+
+def test_free_list_round_trip():
+    cache = BlockKVCache(num_layers=2, num_heads=4, head_dim=8,
+                         num_blocks=9, block_size=16, max_blocks_per_seq=4)
+    assert cache.free_blocks == 8  # block 0 is the reserved trash block
+    assert cache.capacity_tokens == 8 * 16
+    a = cache.alloc(40)  # 3 blocks
+    assert len(a) == 3 and 0 not in a
+    b = cache.alloc(16)
+    assert cache.used_blocks == 4
+    cache.free(a)
+    assert cache.free_blocks == 7
+    # Freed blocks are reused (LIFO — the most recently freed first).
+    c = cache.alloc(48)
+    assert set(c) <= set(a) | set(range(1, 9))
+    assert set(a) & set(c), "freed blocks must be handed out again"
+    cache.free(b)
+    cache.free(c)
+    assert cache.free_blocks == 8
+
+
+def test_allocator_rejects_bad_frees_and_exhaustion():
+    cache = BlockKVCache(num_layers=1, num_heads=1, head_dim=4,
+                         num_blocks=4, block_size=8, max_blocks_per_seq=3)
+    blocks = cache.alloc(24)  # all 3
+    assert not cache.can_alloc(1)
+    with pytest.raises(RuntimeError, match="exhausted"):
+        cache.alloc(8)
+    with pytest.raises(ValueError, match="outside the pool"):
+        cache.free([0])
+    cache.free(blocks)
+    with pytest.raises(ValueError, match="double free"):
+        cache.free([blocks[0]])
+
+
+def test_blocks_for_tokens_math():
+    assert blocks_for_tokens(1, 16) == 1
+    assert blocks_for_tokens(16, 16) == 1
+    assert blocks_for_tokens(17, 16) == 2
+
+
+def test_table_row_pads_with_trash():
+    cache = BlockKVCache(num_layers=1, num_heads=1, head_dim=4,
+                         num_blocks=8, block_size=8, max_blocks_per_seq=5)
+    row = cache.table_row([3, 1])
+    assert row.tolist() == [3, 1, 0, 0, 0]
+
+
+def test_memory_plane_admission_check(model, monkeypatch):
+    """The OOM-safe construction check: a pool that cannot fit the
+    device's remaining HBM refuses at engine build (PR 9 memory plane),
+    never at the first admission."""
+    from fluxmpi_tpu.telemetry import memory as memory_mod
+
+    lm, variables = model
+    monkeypatch.setattr(
+        memory_mod, "device_memory_stats",
+        lambda d: {"bytes_limit": 1024.0, "bytes_in_use": 0.0},
+    )
+    with pytest.raises(RuntimeError, match="device memory"):
+        InferenceEngine(lm, variables, slots=2, block_size=8)
+    serving.shutdown()
+    # Stat-less backends (CPU) have nothing to check against: fine.
+    monkeypatch.setattr(memory_mod, "device_memory_stats", lambda d: {})
+    eng = InferenceEngine(lm, variables, slots=2, block_size=8)
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# Correctness: engine output == generate()
+# ---------------------------------------------------------------------------
+
+
+def test_greedy_streams_bit_identical_to_generate(model, engine_factory):
+    """The serving correctness proof: for a mixed-length batch of
+    requests flowing through admission -> batched prefill -> continuous
+    decode -> eviction, every streamed greedy continuation is
+    bit-identical to ``generate()`` on the same prompt."""
+    lm, variables = model
+    eng = engine_factory(slots=3)
+    eng.warmup(prompt_lengths=(3, 9, 16))
+    rng = np.random.default_rng(7)
+    cases = [(5, 8, None), (9, 4, None), (3, 12, None), (16, 6, None),
+             (6, 20, 3), (4, 1, None)]
+    reqs = [
+        (eng.submit(_prompt(rng, plen), mnew, eos_token=eos), mnew, eos)
+        for plen, mnew, eos in cases
+    ]
+    summary = eng.run()
+    assert summary["completed"] == len(cases)
+    for (req, mnew, eos) in reqs:
+        ref = np.asarray(
+            generate(lm, variables, jnp.asarray(req.prompt[None]), mnew,
+                     eos_token=eos)
+        )[0][len(req.prompt):]
+        if eos is not None:
+            hits = np.where(ref == eos)[0]
+            if len(hits):
+                ref = ref[: hits[0] + 1]  # engine stops AT eos
+        np.testing.assert_array_equal(
+            np.asarray(req.tokens, np.int32), ref
+        )
+    # Eviction returned every block: the pool is whole again.
+    assert eng.cache.free_blocks == eng.cache.num_blocks - 1
+
+
+def test_midflight_join_zero_retrace(model, engine_factory):
+    """A request admitted mid-flight joins the decode batch without
+    recompiling the decode step: the compile monitor sees ZERO compile
+    events after the warmup boundary, and the decode jit's cache holds
+    exactly one entry."""
+    lm, variables = model
+    mon = compileplane.CompileMonitor()
+    compileplane.set_compile_monitor(mon)
+    try:
+        eng = engine_factory(slots=2)
+        eng.warmup(prompt_lengths=(5, 9, 16))
+        mon.observe_flush()  # warmup boundary
+        rng = np.random.default_rng(1)
+        eng.submit(_prompt(rng, 9), 20)
+        for _ in range(3):
+            eng.step()
+        late = eng.submit(_prompt(rng, 5), 8)   # joins mid-flight
+        later = eng.submit(_prompt(rng, 12), 6)  # different length, same buckets
+        summary = eng.run()
+        assert summary["completed"] == 3
+        info = mon.observe_flush()
+        assert info["events"] == 0, f"steady-state compiles: {info}"
+        assert mon.retraces == []
+        assert eng._decode_step._cache_size() == 1
+        ref = np.asarray(
+            generate(lm, variables, jnp.asarray(late.prompt[None]), 8)
+        )[0][5:]
+        np.testing.assert_array_equal(np.asarray(late.tokens, np.int32), ref)
+        assert later.status == "finished"
+    finally:
+        compileplane.set_compile_monitor(None)
+
+
+def test_warmup_touches_only_the_trash_block(model, engine_factory):
+    eng = engine_factory()
+    free_before = eng.cache.free_blocks
+    eng.warmup(prompt_lengths=(4, 11))
+    assert eng.cache.free_blocks == free_before
+    assert eng.queue_depth == 0 and eng.active_count == 0
+
+
+# ---------------------------------------------------------------------------
+# Admission control
+# ---------------------------------------------------------------------------
+
+
+def test_queue_full_rejects_with_counter(model, engine_factory):
+    get_registry().reset()
+    eng = engine_factory(slots=1, max_queue=2)
+    rng = np.random.default_rng(0)
+    reqs = [eng.submit(_prompt(rng, 4), 4) for _ in range(3)]
+    assert [r.status for r in reqs[:2]] == ["queued", "queued"]
+    assert reqs[2].status == "rejected"
+    assert reqs[2].reject_reason == "queue_full"
+    with pytest.raises(RuntimeError, match="queue_full"):
+        reqs[2].result()
+    snap = {
+        (m["name"], tuple(sorted(m["labels"].items()))): m
+        for m in get_registry().snapshot()
+    }
+    key = ("serving.admission_rejects", (("reason", "queue_full"),))
+    assert snap[key]["value"] == 1
+    eng.run()
+
+
+def test_oversized_request_raises(model, engine_factory):
+    eng = engine_factory()
+    rng = np.random.default_rng(0)
+    with pytest.raises(ValueError, match="max_len"):
+        eng.submit(_prompt(rng, 30), eng.max_len)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        eng.submit(_prompt(rng, 4), 0)
+    with pytest.raises(ValueError, match="vocabulary"):
+        eng.submit(_prompt(rng, 4), 4, eos_token=99)
+
+
+def test_capacity_queueing_and_block_reuse(model, engine_factory):
+    """Token-budget admission: a pool sized for ONE request at a time
+    queues the second until eviction frees its blocks — then serves it
+    from the recycled blocks, correctly."""
+    lm, variables = model
+    # 5 usable blocks of 8 = 40 tokens; each request reserves 4 blocks.
+    eng = engine_factory(slots=2, num_blocks=6, max_queue=8)
+    rng = np.random.default_rng(3)
+    a = eng.submit(_prompt(rng, 8), 16)   # 24 tokens -> 3 blocks
+    b = eng.submit(_prompt(rng, 10), 12)  # 22 tokens -> 3 blocks, must wait
+    eng.step()
+    assert a.status == "active" and b.status == "queued"
+    eng.run()
+    assert a.status == "finished" and b.status == "finished"
+    for req, mnew in ((a, 16), (b, 12)):
+        ref = np.asarray(
+            generate(lm, variables, jnp.asarray(req.prompt[None]), mnew)
+        )[0][len(req.prompt):]
+        np.testing.assert_array_equal(np.asarray(req.tokens, np.int32), ref)
+    assert eng.cache.free_blocks == 5
+
+
+def test_static_batching_gangs_admissions(model, engine_factory):
+    """continuous=False is the A/B baseline: a new group is admitted
+    only when every slot has drained, so a short request gangs behind a
+    long one and total decode steps grow — the loss continuous batching
+    exists to recover."""
+    rng = np.random.default_rng(5)
+    workload = [(6, 16), (4, 2), (5, 2), (4, 2)]
+
+    def run_mode(continuous):
+        eng = engine_factory(slots=2, continuous=continuous)
+        for plen, mnew in workload:
+            eng.submit(_prompt(rng, plen), mnew)
+        return eng.run()
+
+    static = run_mode(False)
+    cont = run_mode(True)
+    assert static["completed"] == cont["completed"] == 4
+    assert static["tokens"] == cont["tokens"]
+    assert cont["decode_steps"] < static["decode_steps"]
+
+
+# ---------------------------------------------------------------------------
+# Streaming + latency accounting
+# ---------------------------------------------------------------------------
+
+
+def test_streaming_callback_iterator_and_latency(model, engine_factory):
+    lm, variables = model
+    eng = engine_factory()
+    eng.warmup(prompt_lengths=(5,))
+    rng = np.random.default_rng(11)
+    seen = []
+    eng.start()
+    try:
+        req = eng.submit(_prompt(rng, 5), 10, on_token=seen.append)
+        streamed = list(req.stream(timeout=30.0))
+    finally:
+        eng.stop()
+    assert req.status == "finished"
+    assert streamed == req.tokens == seen
+    ref = np.asarray(
+        generate(lm, variables, jnp.asarray(req.prompt[None]), 10)
+    )[0][5:]
+    np.testing.assert_array_equal(np.asarray(streamed, np.int32), ref)
+    assert req.queue_wait_s is not None and req.queue_wait_s >= 0
+    assert req.ttft_s is not None and req.ttft_s >= req.queue_wait_s
+    assert req.per_token_s is not None and req.per_token_s >= 0
+
+
+def test_slo_violation_counter(model, engine_factory):
+    get_registry().reset()
+    # Impossible SLOs: every completion violates both.
+    eng = engine_factory(slo_ttft_s=0.0, slo_token_s=0.0)
+    rng = np.random.default_rng(2)
+    eng.submit(_prompt(rng, 4), 4)
+    summary = eng.run()
+    assert summary["slo_violations"] == 2
+    snap = {
+        (m["name"], tuple(sorted(m["labels"].items()))): m["value"]
+        for m in get_registry().snapshot()
+        if m["name"] == "serving.slo_violations"
+    }
+    assert snap[("serving.slo_violations", (("kind", "ttft"),))] == 1
+    assert snap[("serving.slo_violations", (("kind", "per_token"),))] == 1
+
+
+# ---------------------------------------------------------------------------
+# Preemption + faults under load (the PR 8 convention)
+# ---------------------------------------------------------------------------
+
+
+def test_sigterm_drains_inflight_rejects_new(model, engine_factory):
+    """The preemption contract under load: in-flight requests decode to
+    completion, queued and new admissions reject, and the summary
+    reports the drained/rejected split."""
+    lm, variables = model
+    eng = engine_factory(slots=2, max_queue=8)
+    rng = np.random.default_rng(9)
+    a = eng.submit(_prompt(rng, 5), 24)
+    b = eng.submit(_prompt(rng, 7), 24)
+    c = eng.submit(_prompt(rng, 4), 4)  # queued behind the two slots
+    eng.step()  # admit a + b
+    runtime.request_preemption()
+    try:
+        summary = eng.run()
+    finally:
+        runtime.clear_preemption()
+    assert summary["preempted"] is True
+    assert summary["drained"] == 2
+    assert summary["rejected"] == 1
+    assert a.status == "finished" and len(a.tokens) == 24
+    assert b.status == "finished" and len(b.tokens) == 24
+    assert c.status == "rejected" and c.reject_reason == "preempted"
+    # Drained output is still the exact generate() continuation.
+    ref = np.asarray(
+        generate(lm, variables, jnp.asarray(a.prompt[None]), 24)
+    )[0][5:]
+    np.testing.assert_array_equal(np.asarray(a.tokens, np.int32), ref)
+    late = eng.submit(_prompt(rng, 4), 4)
+    assert late.status == "rejected" and late.reject_reason == "draining"
+
+
+@pytest.mark.parametrize("site", ["serving.admit", "serving.decode"])
+def test_serving_sites_are_injectable(model, engine_factory, site):
+    # Every serving.* entry of faults.KNOWN_SITES has a live trigger —
+    # the coverage contract the fluxlint unregistered-fault-site rule
+    # greps this file for.
+    eng = engine_factory()
+    rng = np.random.default_rng(4)
+    with faults.scope(site + "@step=1"):
+        with pytest.raises(FaultInjectedError, match=site):
+            if site == "serving.admit":
+                eng.submit(_prompt(rng, 4), 4)
+            else:
+                eng.submit(_prompt(rng, 4), 4)
+                eng.run()
+    # Disarmed: the engine still serves (the decode crash left its slot
+    # active; the rerun drains it cleanly).
+    req = eng.submit(_prompt(rng, 4), 4)
+    eng.run()
+    assert req.status == "finished"
+
+
+def test_decode_stall_feeds_watchdog_clock(model, engine_factory):
+    """A delay= fault at serving.decode stalls the loop in place — and
+    the engine's per-iteration notify_progress keeps feeding the same
+    clock /healthz reads, so a stuck decode is visible liveness, not
+    silence."""
+    from fluxmpi_tpu.telemetry.watchdog import progress_value
+
+    eng = engine_factory()
+    rng = np.random.default_rng(4)
+    before = progress_value()
+    with faults.scope("serving.decode@step=1:delay=0.05"):
+        eng.submit(_prompt(rng, 4), 3)
+        summary = eng.run()
+    assert summary["completed"] == 1
+    assert progress_value() > before
+
+
+# ---------------------------------------------------------------------------
+# Telemetry, status board, env wiring, shutdown discipline
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_schema_valid_and_namespace_closed(model, engine_factory):
+    get_registry().reset()
+    eng = engine_factory()
+    rng = np.random.default_rng(6)
+    eng.submit(_prompt(rng, 5), 6)
+    eng.run()
+    rec = get_registry().flush()
+    assert validate_record(rec) == []
+    emitted = {m["name"] for m in rec["metrics"] if m["name"].startswith("serving.")}
+    assert emitted and emitted <= KNOWN_METRIC_NAMES
+    # The namespace is CLOSED: an off-schema serving.* name is producer
+    # drift, rejected by the validator (and fluxlint at PR time).
+    bad = {"name": "serving.bogus", "type": "gauge", "labels": {}, "value": 1.0}
+    assert any("framework-owned" in e for e in validate_metric(bad))
+
+
+def test_status_board_and_fluxmpi_top_serving_view(model, engine_factory):
+    exp = Exporter(0, "127.0.0.1", deadline=3600.0)
+    export.configure(exp)
+    try:
+        eng = engine_factory()
+        rng = np.random.default_rng(8)
+        for _ in range(3):
+            eng.submit(_prompt(rng, 5), 6)
+        summary = eng.run()
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{exp.port}/status", timeout=5
+        ) as resp:
+            status = json.load(resp)
+        assert validate_status_record(status) == []
+        srv = status["serving"]
+        assert srv["phase"] == "finished"
+        assert srv["completed"] == summary["completed"] == 3
+        assert srv["tokens"] == summary["tokens"]
+        assert srv["kv_blocks_in_use"] == 0
+        # The fleet dashboard renders the serving view from the same
+        # snapshot (stdlib CLI, --once exit semantics unchanged).
+        here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(here, "scripts", "fluxmpi_top.py"),
+             f"http://127.0.0.1:{exp.port}", "--once"],
+            capture_output=True, text=True, timeout=30,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "SERVING" in proc.stdout
+        assert "finished" in proc.stdout
+    finally:
+        export.shutdown()
+
+
+def test_configure_env_forms(model, monkeypatch):
+    serving.shutdown()
+    monkeypatch.setenv("FLUXMPI_TPU_SERVING", "1")
+    monkeypatch.setenv("FLUXMPI_TPU_SERVING_SLOTS", "3")
+    monkeypatch.setenv("FLUXMPI_TPU_SERVING_BLOCK_SIZE", "4")
+    monkeypatch.setenv("FLUXMPI_TPU_SERVING_QUEUE", "5")
+    serving.configure()
+    assert serving.enabled()
+    lm, variables = model
+    eng = InferenceEngine(lm, variables)
+    try:
+        assert eng.slots == 3
+        assert eng.block_size == 4
+        assert eng.max_queue == 5
+    finally:
+        eng.close()
+        serving.shutdown()
+    assert not serving.enabled()
+
+
+def test_configure_dict_and_env_typo(model, monkeypatch):
+    cfg = serving.configure({"slots": 5, "block_size": 8})
+    assert cfg.slots == 5
+    with pytest.raises(ValueError, match="unknown serving config"):
+        serving.configure({"slotz": 5})
+    serving.shutdown()
+    # An env typo degrades with a warning, never crashes bring-up (the
+    # faults.configure convention).
+    monkeypatch.setenv("FLUXMPI_TPU_SERVING_SLOTS", "many")
+    lm, variables = model
+    with pytest.warns(UserWarning, match="FLUXMPI_TPU_SERVING_SLOTS"):
+        eng = InferenceEngine(lm, variables, block_size=8)
+    try:
+        assert eng.slots == 8  # the built-in default
+    finally:
+        eng.close()
+        serving.shutdown()
+
+
+def test_init_serving_kwarg(model, world):
+    fm.init(serving={"slots": 3})
+    assert serving.enabled()
+    lm, variables = model
+    eng = InferenceEngine(lm, variables, block_size=8)
+    assert eng.slots == 3
+    eng.close()
+    fm.init(serving=False)
+    assert not serving.enabled()
+
+
+def test_env_typo_on_master_switch_warns_not_crashes(monkeypatch):
+    # FLUXMPI_TPU_SERVING="true" (a natural typo for "1") must degrade
+    # with a warning, never crash init() of a job that may not even
+    # serve — the export-plane env-typo convention.
+    serving.shutdown()
+    monkeypatch.setenv("FLUXMPI_TPU_SERVING", "true")
+    with pytest.warns(UserWarning, match="FLUXMPI_TPU_SERVING"):
+        cfg = serving.configure()
+    assert cfg is None and not serving.enabled()
+    # The programmatic spelling still raises (a code bug, not a typo).
+    with pytest.raises(ValueError, match="serving spec"):
+        serving.configure("true")
+
+
+def test_serve_thread_error_fails_pending_requests(model, engine_factory):
+    """A dying serve thread must not strand consumers: an error inside
+    an iteration (here the serving.decode chaos site) rejects every
+    pending request with reason="error" and banks the exception."""
+    eng = engine_factory()
+    eng.warmup(prompt_lengths=(4,))
+    rng = np.random.default_rng(0)
+    with faults.scope("serving.decode@step=1"):
+        eng.start()
+        req = eng.submit(_prompt(rng, 4), 8)
+        assert req.wait(timeout=60.0)
+    assert req.status == "rejected" and req.reject_reason == "error"
+    with pytest.raises(RuntimeError, match="error"):
+        list(req.stream(timeout=5.0))
+    assert isinstance(eng.serve_error, FaultInjectedError)
+    eng.stop()
+
+
+def test_stop_then_run_inline_serves_again(model, engine_factory):
+    """The documented driver switch — stop() the serve thread, then
+    drive run() inline — must actually serve: submissions landing in
+    the parked window QUEUE (a parked engine simply has no driver yet)
+    and the next run() drains them; nothing is silently shed."""
+    lm, variables = model
+    eng = engine_factory()
+    rng = np.random.default_rng(3)
+    eng.start()
+    first = eng.submit(_prompt(rng, 4), 4)
+    assert first.wait(timeout=60.0)
+    eng.stop()
+    parked = eng.submit(_prompt(rng, 4), 6)
+    assert parked.status == "queued"
+    summary = eng.run()
+    assert parked.status == "finished" and len(parked.tokens) == 6
+    assert summary["completed"] >= 1
+    ref = np.asarray(
+        generate(lm, variables, jnp.asarray(parked.prompt[None]), 6)
+    )[0][4:]
+    np.testing.assert_array_equal(np.asarray(parked.tokens, np.int32), ref)
+    # tokens_per_sec is per-RUN: the lifetime token count must not be
+    # divided by one run's wall (an idle follow-up run rates 0, while
+    # the lifetime counters keep their totals).
+    idle = eng.run()
+    assert idle["tokens_per_sec"] == 0.0
+    assert idle["tokens"] == summary["tokens"] == 10
+
+
+def test_registry_counters_match_summary_across_driver_switch(
+    model, engine_factory
+):
+    """Decode ticks between the last flush and a driver switch must
+    still reach the cumulative registry counters — the delta baselines
+    survive _resolve_run instead of being silently re-based."""
+    get_registry().reset()
+    eng = engine_factory(flush_every=16)
+    rng = np.random.default_rng(1)
+    eng.submit(_prompt(rng, 4), 8)
+    for _ in range(4):  # admit + a few un-flushed ticks (< flush_every)
+        eng.step()
+    summary = eng.run()
+    snap = {
+        m["name"]: m["value"]
+        for m in get_registry().snapshot()
+        if m["type"] == "counter"
+    }
+    assert snap["serving.decode_steps"] == summary["decode_steps"]
+    assert snap["serving.tokens_generated"] == summary["tokens"]
+
+
+def test_idle_serve_thread_does_not_feed_watchdog(model, engine_factory):
+    """An idle background serving loop must NOT advance the process
+    watchdog progress counter: it would mask a co-resident train
+    loop's stall from the watchdog and /healthz. Progress only moves
+    when the engine admits or decodes."""
+    import time as _time
+
+    from fluxmpi_tpu.telemetry.watchdog import progress_value
+
+    eng = engine_factory()
+    eng.start()
+    try:
+        _time.sleep(0.2)  # several idle poll cycles
+        before = progress_value()
+        _time.sleep(0.3)
+        assert progress_value() == before
+        rng = np.random.default_rng(0)
+        req = eng.submit(_prompt(rng, 4), 4)
+        assert req.wait(timeout=60.0)
+        assert progress_value() > before
+    finally:
+        eng.stop()
+
+
+def test_warmup_refuses_while_serving(model, engine_factory):
+    # warmup dispatches DONATE the pool buffers — racing the serve
+    # thread would invalidate the arrays under its in-flight dispatch.
+    eng = engine_factory()
+    eng.start()
+    try:
+        with pytest.raises(RuntimeError, match="donate"):
+            eng.warmup(prompt_lengths=(8,))
+    finally:
+        eng.stop()
+
+
+def test_stream_timeout_raises_timeout_error(model, engine_factory):
+    # The documented exception type — not the internal queue.Empty.
+    eng = engine_factory()
+    rng = np.random.default_rng(0)
+    req = eng.submit(_prompt(rng, 4), 4)  # queued; nothing drives it
+    with pytest.raises(TimeoutError, match="no token"):
+        list(req.stream(timeout=0.05))
+    eng.run()
+    assert req.status == "finished"
+
+
+def test_engine_close_fails_pending_and_drops_pools(model):
+    get_registry().reset()
+    lm, variables = model
+    eng = InferenceEngine(lm, variables, slots=1, block_size=8, max_queue=4)
+    rng = np.random.default_rng(1)
+    active = eng.submit(_prompt(rng, 5), 30)
+    queued = eng.submit(_prompt(rng, 5), 30)
+    eng.step()
+    assert serving.get_engine() is eng
+    rejected_before = eng._rejected
+    eng.close()
+    assert active.status == "rejected" and active.reject_reason == "shutdown"
+    assert queued.status == "rejected" and queued.reject_reason == "shutdown"
+    # Shutdown rejections ride the same accounting as every other
+    # rejection path — the summary/board must not undercount them.
+    assert eng._rejected == rejected_before + 2
+    snap = {
+        (m["name"], tuple(sorted(m["labels"].items()))): m["value"]
+        for m in get_registry().snapshot()
+        if m["name"] == "serving.admission_rejects"
+    }
+    assert snap[("serving.admission_rejects", (("reason", "shutdown"),))] == 2
+    assert eng.cache._k_pool is None and eng.cache._v_pool is None
+    assert eng.cache.free_blocks == eng.cache.num_blocks - 1
+    assert serving.get_engine() is None
