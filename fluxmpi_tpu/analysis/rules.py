@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import ast
 import difflib
+import re
 from typing import Any, Iterator
 
 from .core import Finding, ModuleSource, Rule
@@ -548,6 +549,26 @@ def _const_prefix(expr: ast.expr) -> str | None:
     return None
 
 
+def _docstring_constants(tree: ast.AST) -> set[int]:
+    """ids of the Constant nodes that are module/class/function
+    docstrings — prose naming a metric is documentation, not a read."""
+    out: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(
+            node,
+            (ast.Module, ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+        ):
+            body = node.body
+            if (
+                body
+                and isinstance(body[0], ast.Expr)
+                and isinstance(body[0].value, ast.Constant)
+                and isinstance(body[0].value.value, str)
+            ):
+                out.add(id(body[0].value))
+    return out
+
+
 class UnknownMetricName(Rule):
     """Metric-name literals handed to ``counter()``/``gauge()``/
     ``histogram()`` must come from ``schema.KNOWN_METRIC_NAMES`` — the
@@ -558,7 +579,18 @@ class UnknownMetricName(Rule):
     goes blank. ``instant()`` trace-event names check against the same
     schema constants (``PREEMPTION_EVENT``, the ``anomaly.`` prefix).
     Dynamic names are skipped unless their constant prefix sits in a
-    closed namespace with no known name under it."""
+    closed namespace with no known name under it.
+
+    **Consumer side**: the dashboards under ``scripts/``
+    (``fluxmpi_top``, ``goodput_report``, ``modelstats_report``) read
+    metric keys as PLAIN string literals — no instrument call to hook —
+    so a key that drifts from the schema there fails only at runtime,
+    as a silently blank panel. Any string literal in a ``scripts/``
+    module that is *shaped* like a metric name (dotted lowercase) and
+    whose first segment names a known metric family must itself be a
+    schema-known name or a family prefix (the ``"monitor."``
+    ``startswith`` idiom). Dotted strings outside the known families
+    (module paths, file suffixes) are ignored, as are docstrings."""
 
     id = "unknown-metric-name"
     severity = "error"
@@ -575,6 +607,60 @@ class UnknownMetricName(Rule):
                 yield from self._check_metric(module, node, ctx)
             elif func.attr == "instant":
                 yield from self._check_instant(module, node, ctx)
+        if module.path.startswith("scripts/"):
+            yield from self._check_consumer_literals(module, ctx)
+
+    _METRIC_SHAPE_RE = re.compile(
+        r"[a-z][a-z0-9_]*(?:\.[a-z0-9_]+)+\.?"
+    )
+
+    def _check_consumer_literals(
+        self, module: ModuleSource, ctx: Any
+    ) -> Iterator[Finding]:
+        known = ctx.known_metric_names
+        allowed = set(known) | {ctx.preemption_event}
+        families = {name.split(".", 1)[0] + "." for name in known}
+        docstrings = _docstring_constants(module.tree)
+        seen: set[str] = set()
+        for node in ast.walk(module.tree):
+            if not (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+            ):
+                continue
+            if id(node) in docstrings:
+                continue
+            text = node.value
+            if not self._METRIC_SHAPE_RE.fullmatch(text):
+                continue
+            if text in allowed or text.startswith(ctx.anomaly_event_prefix):
+                continue
+            if text.split(".", 1)[0] + "." not in families:
+                continue  # dotted, but not a metric-family string
+            if text.endswith("."):
+                # Prefix reads ('monitor.', used with startswith) are
+                # fine when some known name lives under the prefix; a
+                # family-shaped prefix nothing lives under (a
+                # trailing-dot typo like 'train.loss.', a sub-namespace
+                # that was renamed away) is the same blank-panel drift
+                # as a full-name typo.
+                if any(k.startswith(text) for k in allowed):
+                    continue
+            key = text if not text.endswith(".") else f"prefix:{text}"
+            if key in seen:
+                continue
+            seen.add(key)
+            close = difflib.get_close_matches(text, known, n=1)
+            hint = f" (did you mean {close[0]!r}?)" if close else ""
+            yield self.finding(
+                module.path,
+                node,
+                f"metric key literal {text!r} consumed here is not in "
+                f"telemetry/schema.py KNOWN_METRIC_NAMES{hint} — a "
+                f"dashboard reading an unknown key goes blank at "
+                f"runtime; fix the key or add it to the schema",
+                key,
+            )
 
     def _check_metric(
         self, module: ModuleSource, node: ast.Call, ctx: Any

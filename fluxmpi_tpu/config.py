@@ -145,6 +145,40 @@ def disable_device_collectives() -> None:
     )
 
 
+def env_int(
+    name: str,
+    default: int | None = None,
+    *,
+    minimum: int | None = None,
+) -> int | None:
+    """ONE copy of the integer-env-knob parse with the warn-and-default
+    convention (an env typo must degrade, never crash a job — the
+    ``faults.configure`` rule). Unset/empty returns ``default``;
+    garbage, or a value below ``minimum``, warns and returns
+    ``default``. Shared by the serving plane's geometry knobs and the
+    model-internals plane's depth/top-k knobs."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        value = int(raw)
+    except ValueError:
+        warnings.warn(
+            f"ignoring {name}={raw!r}: not an integer"
+            + (f" — using the default {default}" if default is not None else ""),
+            stacklevel=3,
+        )
+        return default
+    if minimum is not None and value < minimum:
+        warnings.warn(
+            f"ignoring {name}={raw!r}: must be >= {minimum}"
+            + (f" — using the default {default}" if default is not None else ""),
+            stacklevel=3,
+        )
+        return default
+    return value
+
+
 def _warn_deprecated_env() -> None:
     if _DEPRECATED_ENV in os.environ:
         warnings.warn(

@@ -401,6 +401,17 @@ def train_loop(
     bundle on disk. Fully disabled (the default), neither plane adds
     perf_counter reads or registry lookups to the hot loop.
 
+    Model internals: when the model-stats plane is on
+    (``init(model_stats=True)`` / ``FLUXMPI_TPU_MODEL_STATS=1``) and the
+    step was built while it was (the tree is part of the compiled
+    program), every flush transfers the small per-layer stats tree and
+    emits the ``model.*`` namespace — per-layer gradient/parameter
+    norms, update-to-weight ratios, nonfinite counts (NaN provenance on
+    the ``nan_grad``/``nan_loss`` anomaly events), and the gradient
+    noise scale on shard_map steps. Identical on the pipelined and
+    fused-window paths (the window program folds the tree into its scan
+    carry). See docs/observability.md, "Model internals".
+
     Live export: with the exporter serving (``init(export=...)`` /
     ``FLUXMPI_TPU_EXPORT_PORT``) the loop posts its status board —
     run config at start, updates/loss/step-time per flush, the outcome
@@ -483,6 +494,7 @@ def train_loop(
     from ..telemetry import compileplane as _compileplane
     from ..telemetry import export as _export
     from ..telemetry import goodput as _goodput
+    from ..telemetry import modelstats as _modelstats
     from .train import _DEFAULT_REGISTRY
 
     # Run-health + device planes, resolved ONCE per run (the
@@ -506,6 +518,22 @@ def train_loop(
     # calls note_status (monkeypatch-explode tested).
     exporter = _export.get_exporter()
     exp_on = exporter is not None and exporter.enabled
+    # Model-internals plane: the stats tree is baked into the compiled
+    # program at build time (make_train_step(model_stats=)); the loop's
+    # job is flush-boundary consumption — ONE device→host copy of the
+    # small per-layer tree per flush, riding the drain the flush already
+    # pays. On when the plane is installed AND the step actually carries
+    # the tree; fully off, this is one module attribute read per run.
+    ms = _modelstats.get_model_stats()
+    ms_aux = getattr(hot, "__fluxmpi_aux__", None)
+    ms_meta = getattr(hot, "__fluxmpi_model_stats_meta__", None)
+    ms_on = (
+        ms is not None
+        and ms.enabled
+        and ms_meta is not None
+        and ms_aux is not None
+        and "model_stats" in ms_aux
+    )
     if cp_on:
         # Tag the hot step for retrace attribution: its jit-cache growth
         # after the warmup boundary names it in the steady_state_retrace
@@ -936,15 +964,19 @@ def train_loop(
         notify_progress(interval_updates)
         loss_v: float | None = None
         grad_v: float | None = None
+        stats_host: Any = None
         window_stats: dict[str, float] = {}
-        if record_metrics or det_on or exp_on:
+        if record_metrics or det_on or exp_on or ms_on:
             if fused_w:
                 # The window program's metric carry: a dict of f32
-                # scalars — ONE tiny device→host transfer per flush.
+                # scalars (plus the model-stats tree when the plane is
+                # on) — ONE tiny device→host transfer per flush.
                 vals = jax.device_get(last_out)
                 loss_v = float(np.asarray(vals["loss"]))
                 if "grad_norm" in vals:
                     grad_v = float(np.asarray(vals["grad_norm"]))
+                if ms_on:
+                    stats_host = vals.get("model_stats")
                 if last_width > 0:
                     window_stats["loss_window_mean"] = (
                         float(np.asarray(vals["loss_sum"])) / last_width
@@ -953,15 +985,32 @@ def train_loop(
                     np.asarray(vals["loss_max"])
                 )
             else:
-                leaves = jax.tree_util.tree_leaves(last_out)
-                loss_h = (
-                    np.asarray(jax.device_get(leaves[0])) if leaves else None
-                )
-                loss_v = float(loss_h.mean()) if loss_h is not None else None
-                if len(leaves) > 1:
-                    grad_v = float(
-                        np.asarray(jax.device_get(leaves[1])).mean()
+                if ms_on:
+                    # Aux is (loss, grad_norm, stats): pull the whole
+                    # tuple across in one transfer; a scan_steps step
+                    # stacks each leaf [K] — the flush describes the
+                    # NEWEST update, so take the last entry.
+                    vals = jax.device_get(last_out)
+                    loss_v = float(np.asarray(vals[0]).mean())
+                    grad_v = float(np.asarray(vals[1]).mean())
+                    stats_host = vals[2]
+                    if k > 1:
+                        from .train import _last_scan_entry
+
+                        stats_host = _last_scan_entry(stats_host)
+                else:
+                    leaves = jax.tree_util.tree_leaves(last_out)
+                    loss_h = (
+                        np.asarray(jax.device_get(leaves[0]))
+                        if leaves else None
                     )
+                    loss_v = (
+                        float(loss_h.mean()) if loss_h is not None else None
+                    )
+                    if len(leaves) > 1:
+                        grad_v = float(
+                            np.asarray(jax.device_get(leaves[1])).mean()
+                        )
         if record_metrics:
             record: dict[str, Any] = {
                 "step_seconds": per_update,
@@ -1021,6 +1070,22 @@ def train_loop(
             if info["steady"] and info["events"]:
                 retraces = info["events"]
                 retraced = ",".join(info["functions"])
+        msum: dict[str, Any] | None = None
+        if ms_on and stats_host is not None:
+            # Emit the model.* namespace and fold the per-layer view
+            # into one summary for the detector and the status board.
+            # The noise-scale ingredients (shard_map steps) divide by
+            # the per-update batch, identical on both drivers.
+            msum = ms.observe_flush(
+                stats_host,
+                step=updates,
+                registry=_live_registry() if record_metrics else None,
+                batch_examples=(
+                    interval_examples / interval_updates
+                    if interval_updates else None
+                ),
+                workers=ms_meta.get("workers"),
+            )
         if det_on:
             events = detector.observe(
                 loss=loss_v,
@@ -1029,6 +1094,10 @@ def train_loop(
                 fetch_seconds=fetch_per_update,
                 retraces=retraces,
                 retraced=retraced,
+                layer_grad_norms=msum["layers"] if msum else None,
+                nonfinite_layer=(
+                    msum["nonfinite_layer"] if msum else None
+                ),
                 step=updates,
             )
             for ev in events:
@@ -1049,6 +1118,18 @@ def train_loop(
                 ),
                 dispatches=dispatches,
             )
+            if msum is not None:
+                # The MODEL board: noise scale, top-k layers by grad
+                # norm, and NaN provenance — what fluxmpi_top renders.
+                exporter.note_model(
+                    step=updates,
+                    noise_scale=msum["noise_scale"],
+                    nonfinite_layer=msum["nonfinite_layer"],
+                    top=[
+                        {"layer": layer, "grad_norm": gnorm}
+                        for layer, gnorm in msum["top"]
+                    ],
+                )
         interval_updates = 0
         interval_examples = 0
         interval_windows = 0

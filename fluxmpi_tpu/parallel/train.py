@@ -119,6 +119,27 @@ def _tag_scan_steps(step: Any, scan_steps: int) -> None:
         pass
 
 
+def _bank_aux_meta(
+    compiled: Any,
+    aux_names: tuple[str, ...],
+    stats_depth: int | None,
+    workers: int,
+) -> None:
+    """Record the compiled step's auxiliary-output structure (and, with
+    model stats baked in, the plane metadata) so ``train_loop`` can
+    unpack the flush values without guessing. Best-effort like
+    :func:`_tag_scan_steps`."""
+    try:
+        compiled.__fluxmpi_aux__ = aux_names
+        if stats_depth is not None:
+            compiled.__fluxmpi_model_stats_meta__ = {
+                "depth": stats_depth,
+                "workers": workers,
+            }
+    except (AttributeError, TypeError):  # pragma: no cover - jax-version
+        pass
+
+
 def _resolve_metrics(metrics: Any) -> tuple[Any, Any, Any]:
     """Normalize a ``metrics=`` spec to (registry, monitor, hook)."""
     from ..telemetry import MetricsRegistry, TrainingMonitor
@@ -137,9 +158,23 @@ def _resolve_metrics(metrics: Any) -> tuple[Any, Any, Any]:
     )
 
 
-def _instrument_step(compiled, metrics: Any, scan_steps: int):
-    """Wrap a compiled step that returns ``(state, (loss, grad_norm))``
-    into the public ``(state, loss)`` signature, recording telemetry.
+def _last_scan_entry(tree: Any) -> Any:
+    """Last scanned element of each leaf of a stacked ``[K]`` host tree
+    (the flush-boundary selection: stats describe the newest update)."""
+    return jax.tree_util.tree_map(lambda a: np.asarray(a)[-1], tree)
+
+
+def _instrument_step(
+    compiled,
+    metrics: Any,
+    scan_steps: int,
+    *,
+    stats_on: bool = False,
+    stats_workers: int = 1,
+):
+    """Wrap a compiled step that returns ``(state, (loss, grad_norm[,
+    model_stats]))`` into the public ``(state, loss)`` signature,
+    recording telemetry.
 
     Timing follows the :func:`~fluxmpi_tpu.utils.step_timer` discipline:
     the clock stops only after blocking on the step's outputs, so async
@@ -152,52 +187,81 @@ def _instrument_step(compiled, metrics: Any, scan_steps: int):
     enabled; one no-op call otherwise) and a watchdog progress tick —
     an armed :class:`~fluxmpi_tpu.telemetry.Watchdog` counts completed
     steps as liveness.
+
+    With ``stats_on`` (the model-internals plane baked stats into the
+    program) the per-layer tree is transferred and emitted per call —
+    direct step users get per-step granularity; ``train_loop`` bypasses
+    this wrapper and consumes the same tree at flush granularity. A
+    ``metrics`` of ``None``/``False`` records nothing but still strips
+    the auxiliary outputs (the stats-only wrapper).
     """
     from ..telemetry import get_registry
+    from ..telemetry import modelstats as _modelstats
     from ..telemetry import tracing as _tracing
     from ..telemetry.watchdog import notify_progress
     from ..utils.profiling import step_timer
 
-    reg, monitor, hook = _resolve_metrics(metrics)
+    record_metrics = metrics is not None and metrics is not False
+    reg, monitor, hook = (None, None, None)
+    if record_metrics:
+        reg, monitor, hook = _resolve_metrics(metrics)
 
     def step(state, batch):
         holder: dict[str, float] = {}
         with _tracing.span("train.step"):
             with step_timer(holder) as t:
-                new_state, (loss, gnorm) = compiled(state, batch)
+                new_state, aux = compiled(state, batch)
+                loss, gnorm = aux[0], aux[1]
                 t.watch((loss, gnorm))
         notify_progress()
         seconds = holder["seconds"]
-        loss_h = np.asarray(jax.device_get(loss))
-        gnorm_h = np.asarray(jax.device_get(gnorm))
         leaves = jax.tree_util.tree_leaves(batch)
         examples = 0
         if leaves and getattr(leaves[0], "ndim", 0):
             examples = int(np.shape(leaves[0])[0])
             if scan_steps > 1:  # leading axis is scan time, not data
                 examples *= int(np.shape(leaves[0])[1])
-        record = {
-            "step_seconds": seconds,
-            "loss": float(loss_h.mean()),
-            "grad_norm": float(gnorm_h.mean()),
-            "examples": examples,
-            "examples_per_sec": examples / seconds if seconds > 0 else 0.0,
-            "steps": scan_steps,
-        }
-        registry = get_registry() if reg is _DEFAULT_REGISTRY else reg
-        if registry is not None:
-            registry.histogram("train.step_seconds").observe(seconds)
-            registry.gauge("train.loss").set(record["loss"])
-            registry.gauge("train.grad_norm").set(record["grad_norm"])
-            registry.gauge("train.examples_per_sec").set(
-                record["examples_per_sec"]
-            )
-            registry.counter("train.steps").inc(scan_steps)
-            registry.counter("train.examples").inc(examples)
-        if monitor is not None:
-            monitor.observe_step(seconds)
-        if hook is not None:
-            hook(record)
+        if record_metrics:
+            loss_h = np.asarray(jax.device_get(loss))
+            gnorm_h = np.asarray(jax.device_get(gnorm))
+            record = {
+                "step_seconds": seconds,
+                "loss": float(loss_h.mean()),
+                "grad_norm": float(gnorm_h.mean()),
+                "examples": examples,
+                "examples_per_sec": examples / seconds if seconds > 0 else 0.0,
+                "steps": scan_steps,
+            }
+            registry = get_registry() if reg is _DEFAULT_REGISTRY else reg
+            if registry is not None:
+                registry.histogram("train.step_seconds").observe(seconds)
+                registry.gauge("train.loss").set(record["loss"])
+                registry.gauge("train.grad_norm").set(record["grad_norm"])
+                registry.gauge("train.examples_per_sec").set(
+                    record["examples_per_sec"]
+                )
+                registry.counter("train.steps").inc(scan_steps)
+                registry.counter("train.examples").inc(examples)
+            if monitor is not None:
+                monitor.observe_step(seconds)
+            if hook is not None:
+                hook(record)
+        if stats_on:
+            ms = _modelstats.get_model_stats()
+            if ms is not None and ms.enabled:
+                stats_host = jax.device_get(aux[2])
+                if scan_steps > 1:
+                    stats_host = _last_scan_entry(stats_host)
+                ms.observe_flush(
+                    stats_host,
+                    registry=(
+                        get_registry() if reg is _DEFAULT_REGISTRY else reg
+                    ),
+                    batch_examples=(
+                        examples / scan_steps if scan_steps > 0 else None
+                    ),
+                    workers=stats_workers,
+                )
         return new_state, loss
 
     step.__wrapped__ = compiled  # cost_analysis / AOT access to the jit
@@ -228,6 +292,7 @@ def make_train_step(
     scan_steps: int = 1,
     policy: Any | None = None,
     metrics: Any | None = None,
+    model_stats: Any | None = None,
 ) -> Callable[[TrainState, Any], tuple[TrainState, jax.Array]]:
     """Build a compiled data-parallel train step.
 
@@ -308,6 +373,22 @@ def make_train_step(
         ``train.examples``. The per-step block on the loss serializes
         async dispatch — on remote/tunneled targets prefer a larger
         effective step (``scan_steps``) when enabling this.
+      model_stats: fold the model-internals plane's per-layer stats tree
+        into the compiled program (``None``, the default, follows the
+        installed :class:`~fluxmpi_tpu.telemetry.ModelStats` plane —
+        ``init(model_stats=True)`` / ``FLUXMPI_TPU_MODEL_STATS=1``;
+        ``True``/``False`` force it, an int sets the grouping depth):
+        per-layer gradient/parameter/update norms and nonfinite-gradient
+        counts (NaN provenance), grouped by leaf-path depth so the tree
+        stays O(layers), plus — under ``style="shard_map"`` with a
+        ``grad_reduce`` — the pre-allreduce local gradient sq-norm the
+        gradient-noise-scale estimate (B_simple) needs. Computed from
+        the values the program already materializes; the update math is
+        untouched (a run with it on is bit-identical to one with it
+        off). Consumed at ``train_loop`` flush boundaries (one tiny
+        device→host copy per flush) or per call when the step is driven
+        directly; see :mod:`fluxmpi_tpu.telemetry.modelstats` and
+        docs/observability.md "Model internals".
 
     Returns:
       ``step(state, batch) -> (new_state, loss)`` — compiled, collective
@@ -354,6 +435,7 @@ def make_train_step(
                 model_state=new_mstate,
             ),
             loss,
+            updates,
         )
 
     if grad_accum_steps < 1:
@@ -371,14 +453,39 @@ def make_train_step(
     if instrument:
         _resolve_metrics(metrics)  # reject bad specs at build, not step 1
 
-    def _result(new_ts: TrainState, loss, grads):
+    # Model-internals plane: resolved at BUILD time (the stats tree is
+    # part of the compiled program — a plane installed later cannot
+    # reach into an existing executable). None when off: the program
+    # then computes nothing extra (the zero-cost contract).
+    from ..telemetry import modelstats as _modelstats
+
+    stats_depth = _modelstats.resolve_step_spec(model_stats)
+    stats_on = stats_depth is not None
+    dp_workers = int(mesh.shape[name]) if name in mesh.shape else 1
+    aux_names: tuple[str, ...] = ("loss",)
+    if instrument or stats_on:
+        aux_names = ("loss", "grad_norm")
+    if stats_on:
+        aux_names = aux_names + ("model_stats",)
+
+    def _result(ts: TrainState, new_ts: TrainState, loss, grads, updates,
+                noise=None):
         # Instrumented steps carry the global grad-norm out of the
         # compiled program alongside the loss (computing it host-side
-        # would re-materialize the gradient tree); the wrapper strips it
-        # so the public signature stays (state, loss).
-        if not instrument:
+        # would re-materialize the gradient tree); with model stats on,
+        # the per-layer tree rides the same slot. The wrapper strips
+        # the extras so the public signature stays (state, loss).
+        if not instrument and not stats_on:
             return new_ts, loss
-        return new_ts, (loss, optax.global_norm(grads))
+        aux = [loss, optax.global_norm(grads)]
+        if stats_on:
+            stats = _modelstats.compute_stats(
+                grads, ts.params, updates, depth=stats_depth
+            )
+            if noise is not None:
+                stats["noise"] = noise
+            aux.append(stats)
+        return new_ts, tuple(aux)
 
     if style == "auto":
 
@@ -400,8 +507,8 @@ def make_train_step(
                     ts.params, ts.model_state, batch
                 )
                 grads = _pin_grads(grads)
-                new_ts, loss = _apply_update(ts, grads, loss, new_mstate)
-                return _result(new_ts, loss, grads)
+                new_ts, loss, upd = _apply_update(ts, grads, loss, new_mstate)
+                return _result(ts, new_ts, loss, grads, upd)
 
         else:
 
@@ -433,8 +540,8 @@ def make_train_step(
                 grads = _pin_grads(
                     jax.tree_util.tree_map(lambda x: x / k, g)
                 )
-                new_ts, loss = _apply_update(ts, grads, l / k, ms)
-                return _result(new_ts, loss, grads)
+                new_ts, loss, upd = _apply_update(ts, grads, l / k, ms)
+                return _result(ts, new_ts, loss, grads, upd)
 
         single_update = step  # the one-update body the fused window scans
         if scan_steps > 1:
@@ -473,11 +580,20 @@ def make_train_step(
                 "mesh": mesh,
                 "donate": donate,
                 "instrument": instrument,
+                "aux": aux_names,
+                "stats_depth": stats_depth,
             }
         except (AttributeError, TypeError):  # pragma: no cover - jax-version
             pass
-        if instrument:
-            return _instrument_step(compiled, metrics, scan_steps)
+        _bank_aux_meta(compiled, aux_names, stats_depth, dp_workers)
+        if instrument or stats_on:
+            return _instrument_step(
+                compiled,
+                metrics if instrument else False,
+                scan_steps,
+                stats_on=stats_on,
+                stats_workers=dp_workers,
+            )
         return compiled
     if state_sharding is not None or batch_spec is not None:
         raise ValueError(
@@ -492,8 +608,18 @@ def make_train_step(
     # stay device-local until the explicit reduction — the reference's
     # "each rank holds local grads, then allreduce" model
     # (src/optimizer.jl:45-65).
+    # The noise-scale ingredients exist exactly where the reference's
+    # allreduce structure does: each rank's pre-allreduce gradient is an
+    # independent estimate at the per-rank batch, and the reduced
+    # gradient the estimate at the global batch — the two norms B_simple
+    # needs (telemetry/modelstats.noise_scale). The partitioner-driven
+    # style="auto" path never materializes a per-rank gradient, so this
+    # is deliberately shard_map-only.
+    noise_on = stats_on and grad_reduce in ("mean", "sum")
+
     def step_body(ts: TrainState, batch):
         (loss, new_mstate), grads = grad_and_aux(ts.params, ts.model_state, batch)
+        local_sq = optax.global_norm(grads) ** 2 if noise_on else None
         if grad_reduce == "mean":
             grads = jax.lax.pmean(grads, name)
             loss = jax.lax.pmean(loss, name)
@@ -507,16 +633,35 @@ def make_train_step(
                 else s,
                 new_mstate,
             )
-        new_ts, loss = _apply_update(ts, grads, loss, new_mstate)
-        return _result(new_ts, loss, grads)
+        noise = None
+        if noise_on:
+            global_sq = optax.global_norm(grads) ** 2
+            if grad_reduce == "sum":
+                # The summed gradient is workers × the mean; B_simple's
+                # "big batch" estimator is the AVERAGE, so rescale its
+                # sq-norm (the optimizer still consumes the sum).
+                global_sq = global_sq / float(dp_workers) ** 2
+            noise = {
+                "local_sqnorm": jax.lax.pmean(local_sq, name),
+                "global_sqnorm": global_sq,
+            }
+        new_ts, loss, upd = _apply_update(ts, grads, loss, new_mstate)
+        return _result(ts, new_ts, loss, grads, upd, noise=noise)
 
     mapped = shard_map_unchecked(
         step_body, mesh, in_specs=(P(), P(name)), out_specs=(P(), P())
     )
     compiled = jax.jit(mapped, donate_argnums=(0,) if donate else ())
     _tag_scan_steps(compiled, 1)
-    if instrument:
-        return _instrument_step(compiled, metrics, 1)
+    _bank_aux_meta(compiled, aux_names, stats_depth, dp_workers)
+    if instrument or stats_on:
+        return _instrument_step(
+            compiled,
+            metrics if instrument else False,
+            1,
+            stats_on=stats_on,
+            stats_workers=dp_workers,
+        )
     return compiled
 
 
@@ -569,6 +714,16 @@ def make_window_program(
     single = meta["single"]
     mesh = meta["mesh"]
     instrument = meta["instrument"]
+    # Aux structure of the single-update body: (loss[, grad_norm[,
+    # model_stats]]) — steps built before the model-internals plane
+    # banked "aux" fall back to the instrument flag's two shapes.
+    aux_names = meta.get("aux") or (
+        ("loss", "grad_norm") if instrument else ("loss",)
+    )
+    carries_aux = len(aux_names) > 1
+    stats_on = "model_stats" in aux_names
+    if stats_on:
+        from ..telemetry import modelstats as _modelstats
     batch_sharding = NamedSharding(mesh, meta["batch_spec"])
     replicated = NamedSharding(mesh, P())
 
@@ -581,8 +736,12 @@ def make_window_program(
             # jit's out_shardings produced.
             batch = jax.lax.with_sharding_constraint(batch, batch_sharding)
             out = single(st, batch)
-            if instrument:
-                new_st, (loss, gnorm) = out
+            stats = None
+            if carries_aux:
+                new_st, aux = out
+                loss, gnorm = aux[0], aux[1]
+                if stats_on:
+                    stats = aux[2]
             else:
                 new_st, loss = out
                 gnorm = None
@@ -594,8 +753,14 @@ def make_window_program(
                 "loss_sum": m["loss_sum"] + loss32,
                 "loss_max": jnp.maximum(m["loss_max"], loss32),
             }
-            if instrument:
+            if carries_aux:
                 new_m["grad_norm"] = gnorm.astype(jnp.float32)
+            if stats is not None:
+                # Last update's tree wins the carry — the same
+                # flush-boundary selection the pipelined path makes
+                # ([-1] of the stacked scan outputs). Already f32 by
+                # construction (compute_stats accumulates in f32).
+                new_m["model_stats"] = stats
             return (new_st, new_m), None
 
         m0 = {
@@ -603,8 +768,14 @@ def make_window_program(
             "loss_sum": jnp.zeros((), jnp.float32),
             "loss_max": jnp.full((), -jnp.inf, jnp.float32),
         }
-        if instrument:
+        if carries_aux:
             m0["grad_norm"] = jnp.zeros((), jnp.float32)
+        if stats_on:
+            # Zeros with compute_stats' exact structure (both sides
+            # derive groups from the same param treedef + depth).
+            m0["model_stats"] = _modelstats.stats_zeros(
+                ts.params, depth=meta["stats_depth"]
+            )
         (new_ts, metrics), _ = jax.lax.scan(
             body, (ts, m0), jnp.arange(width, dtype=jnp.int32)
         )

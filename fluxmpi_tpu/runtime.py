@@ -301,6 +301,7 @@ def init(
     faults: Any = None,
     goodput: Any = None,
     anomaly: Any = None,
+    model_stats: Any = None,
     compileplane: Any = None,
     memory: Any = None,
     profile: Any = None,
@@ -374,6 +375,18 @@ def init(
         :mod:`fluxmpi_tpu.telemetry.anomaly`). ``None`` defers to
         ``FLUXMPI_TPU_ANOMALY``. All the observability/robustness specs
         are applied on idempotent replays too.
+      model_stats: install the model-internals plane — ``True`` makes
+        ``make_train_step`` fold a per-layer stats tree into the
+        compiled program (per-layer gradient/parameter norms,
+        update-to-weight ratios, nonfinite counts for NaN provenance,
+        gradient noise scale on shard_map steps) that ``train_loop``
+        emits as ``model.*`` metrics at flush boundaries; an int sets
+        the leaf-path grouping depth, or pass a
+        :class:`~fluxmpi_tpu.telemetry.ModelStats`. ``None`` defers to
+        ``FLUXMPI_TPU_MODEL_STATS`` (depth/top-k knobs:
+        ``FLUXMPI_TPU_MODEL_STATS_DEPTH`` /
+        ``FLUXMPI_TPU_MODEL_STATS_TOPK``). See
+        :mod:`fluxmpi_tpu.telemetry.modelstats`.
       compileplane: install the compile/retrace monitor — ``True``
         subscribes to ``jax.monitoring`` compile events, emits
         ``compile.*`` metrics at ``train_loop`` flush boundaries, and
@@ -431,6 +444,7 @@ def init(
     from .telemetry import export as _export
     from .telemetry import goodput as _goodput
     from .telemetry import memory as _memory
+    from .telemetry import modelstats as _modelstats
     from .telemetry import tracing as _tracing
     from .telemetry import watchdog as _watchdog
     from .utils import profiling as _profiling
@@ -445,6 +459,7 @@ def init(
         _faults_mod.configure(faults)
         _goodput.configure(goodput)
         _anomaly.configure(anomaly)
+        _modelstats.configure(model_stats)
         _compileplane.configure(compileplane)
         _memory.configure(memory)
         _profiling.configure_auto_profiler(profile)
@@ -507,6 +522,7 @@ def init(
     _faults_mod.configure(faults)
     _goodput.configure(goodput)
     _anomaly.configure(anomaly)
+    _modelstats.configure(model_stats)
     _compileplane.configure(compileplane)
     _memory.configure(memory)
     _profiling.configure_auto_profiler(profile)

@@ -85,19 +85,11 @@ _DEFAULT_MAX_QUEUE = 64
 
 
 def _env_int(name: str) -> int | None:
-    """An int env knob; garbage warns and falls back to None (the
-    faults.configure env-typo convention — a typo degrades, never
-    crashes a serving job)."""
-    raw = os.environ.get(name)
-    if raw is None or raw == "":
-        return None
-    try:
-        return int(raw)
-    except ValueError:
-        warnings.warn(
-            f"ignoring {name}={raw!r}: not an integer", stacklevel=3
-        )
-        return None
+    """An int env knob; garbage warns and falls back to None — the ONE
+    shared warn-and-default parser (``config.env_int``)."""
+    from ..config import env_int
+
+    return env_int(name)
 
 
 class ServingConfig:

@@ -140,6 +140,12 @@ from .anomaly import (  # noqa: F401
     get_anomaly_detector,
     set_anomaly_detector,
 )
+from . import modelstats  # noqa: F401
+from .modelstats import (  # noqa: F401
+    ModelStats,
+    get_model_stats,
+    set_model_stats,
+)
 from . import compileplane  # noqa: F401
 from .compileplane import (  # noqa: F401
     CompileMonitor,
@@ -196,6 +202,9 @@ __all__ = [
     "AnomalyDetector",
     "get_anomaly_detector",
     "set_anomaly_detector",
+    "ModelStats",
+    "get_model_stats",
+    "set_model_stats",
     "CompileMonitor",
     "get_compile_monitor",
     "set_compile_monitor",
@@ -273,7 +282,8 @@ def shutdown() -> None:
     half-reset process), disarm the watchdog, export the trace ring
     (when a path was configured) then reset the tracer and the flight
     recorder ring, reset the run-health plane (goodput window + anomaly
-    detector) and the device plane (compile monitor, HBM watermark,
+    detector), the model-internals plane, and the device plane (compile
+    monitor, HBM watermark,
     auto-profiler — state left armed would leak into the next init
     cycle), then flush and detach every sink on the default registry
     (instruments survive — a re-configured registry keeps its cumulative
@@ -313,6 +323,10 @@ def shutdown() -> None:
         pass
     try:
         anomaly.shutdown()
+    except Exception:
+        pass
+    try:
+        modelstats.shutdown()
     except Exception:
         pass
     try:

@@ -26,6 +26,18 @@ rule                        trigger
                             boundary — a shape or Python-identity change
                             is silently recompiling the step; the event
                             names the recompiled function
+``layer_grad_explosion``    one layer's gradient norm (from the
+                            model-internals plane,
+                            :mod:`~fluxmpi_tpu.telemetry.modelstats`)
+                            exceeds ``layer_explosion_factor`` × its own
+                            per-layer EWMA (after ``warmup``) — the
+                            layer-localized precursor the global norm
+                            averages away; the event names the layer
+``dead_layer``              one layer's gradient norm stays at ≈0
+                            (``dead_layer_eps``) for
+                            ``dead_layer_flushes`` consecutive flushes —
+                            a frozen / disconnected / saturated layer;
+                            the event names the layer
 ==========================  ================================================
 
 Each rule carries a **policy**: ``"warn"`` (record and continue),
@@ -93,6 +105,8 @@ RULES = (
     "step_time_regression",
     "data_stall",
     "steady_state_retrace",
+    "layer_grad_explosion",
+    "dead_layer",
 )
 
 POLICIES = ("warn", "halt", "off")
@@ -106,6 +120,13 @@ _DEFAULT_POLICIES = {
     # Per-host signal (each process compiles independently) — never a
     # halt default, like the other statistical rules.
     "steady_state_retrace": "warn",
+    # Model-internals rules (PR 14): statistical per-layer signals —
+    # warn-default per the statistical-rule policy (the per-layer
+    # norms ARE SPMD-consistent global scalars, but a z-score/EWMA
+    # threshold is a judgment call, not a proof of divergence; the NaN
+    # rules stay the halting pair).
+    "layer_grad_explosion": "warn",
+    "dead_layer": "warn",
 }
 
 # Rules whose trigger is *performance* evidence an XPlane capture can
@@ -136,6 +157,15 @@ class AnomalyDetector:
       data_stall_factor: per-update loader wait > factor × the interval's
         compute remainder (step time − wait) = input-bound (the wait is
         part of the step time, so it is judged against what is left).
+      layer_explosion_factor: a layer's gradient norm > factor × its own
+        EWMA (after ``warmup`` per-layer observations) = layer gradient
+        explosion. Wider than the step-time factor by default — healthy
+        per-layer norms are far noisier than step times.
+      dead_layer_eps: a layer whose gradient norm stays ≤ this is
+        considered gradient-dead (0.0 exactly means a disconnected
+        layer; the default tolerates denormal dust).
+      dead_layer_flushes: consecutive dead flushes before ``dead_layer``
+        fires (once per streak; a recovery re-arms it).
       dump_dir: where the diagnostics bundle lands (default
         ``FLUXMPI_TPU_ANOMALY_DIR`` or ``.``); stable per-process
         filename, latest trigger wins (the watchdog convention).
@@ -153,6 +183,9 @@ class AnomalyDetector:
         warmup: int = 5,
         step_time_factor: float = 3.0,
         data_stall_factor: float = 1.0,
+        layer_explosion_factor: float = 10.0,
+        dead_layer_eps: float = 1e-12,
+        dead_layer_flushes: int = 3,
         dump_dir: str | None = None,
         dump: bool = True,
     ):
@@ -179,6 +212,13 @@ class AnomalyDetector:
         self.warmup = int(warmup)
         self.step_time_factor = float(step_time_factor)
         self.data_stall_factor = float(data_stall_factor)
+        if dead_layer_flushes < 1:
+            raise ValueError(
+                f"dead_layer_flushes must be >= 1, got {dead_layer_flushes}"
+            )
+        self.layer_explosion_factor = float(layer_explosion_factor)
+        self.dead_layer_eps = float(dead_layer_eps)
+        self.dead_layer_flushes = int(dead_layer_flushes)
         self.dump_dir = (
             dump_dir
             if dump_dir is not None
@@ -194,6 +234,11 @@ class AnomalyDetector:
         self._loss_n = 0
         self._step_mean = 0.0
         self._step_n = 0
+        # Per-layer EWMA gradient-norm baselines (model-internals
+        # plane) and the consecutive-dead-flush streaks.
+        self._layer_mean: dict[str, float] = {}
+        self._layer_n: dict[str, int] = {}
+        self._dead_streak: dict[str, int] = {}
 
     # -- rule engine ---------------------------------------------------
 
@@ -226,6 +271,8 @@ class AnomalyDetector:
         fetch_seconds: float | None = None,
         retraces: int | None = None,
         retraced: str | None = None,
+        layer_grad_norms: dict[str, float] | None = None,
+        nonfinite_layer: str | None = None,
         step: int | None = None,
     ) -> list[dict[str, Any]]:
         """Evaluate every armed rule against one flush interval's
@@ -241,7 +288,12 @@ class AnomalyDetector:
         from the compile plane's
         :meth:`~fluxmpi_tpu.telemetry.compileplane.CompileMonitor.observe_flush`,
         with ``retraced`` naming the recompiled function(s) — the
-        ``steady_state_retrace`` event carries it as ``function``)."""
+        ``steady_state_retrace`` event carries it as ``function``;
+        ``layer_grad_norms`` is the model-internals plane's per-layer
+        view feeding the ``layer_grad_explosion``/``dead_layer`` rules,
+        and ``nonfinite_layer`` its NaN provenance — the first layer
+        whose gradients went nonfinite, carried on the ``nan_grad`` /
+        ``nan_loss`` events as ``layer``)."""
         if not self.enabled:
             return []
         events: list[dict[str, Any]] = []
@@ -251,6 +303,13 @@ class AnomalyDetector:
             if not _finite(loss):
                 ev = self._event("nan_loss", loss, step)
                 if ev:
+                    if nonfinite_layer is not None:
+                        # NaN provenance from the model-internals
+                        # plane: the first layer whose gradients went
+                        # nonfinite — a NaN loss back-propagates NaN
+                        # into every layer, so the forward-side culprit
+                        # is what a responder actually needs named.
+                        ev["layer"] = nonfinite_layer
                     events.append(ev)
             else:
                 if self._loss_n >= self.warmup:
@@ -279,6 +338,8 @@ class AnomalyDetector:
             if not _finite(grad_norm):
                 ev = self._event("nan_grad", grad_norm, step)
                 if ev:
+                    if nonfinite_layer is not None:
+                        ev["layer"] = nonfinite_layer
                     events.append(ev)
 
         if step_seconds is not None and step_seconds > 0:
@@ -324,6 +385,46 @@ class AnomalyDetector:
                 if ev:
                     events.append(ev)
 
+        if layer_grad_norms:
+            for lname, norm in layer_grad_norms.items():
+                norm = float(norm)
+                if not _finite(norm):
+                    continue  # the NaN rules own nonfinite gradients
+                n = self._layer_n.get(lname, 0)
+                mean = self._layer_mean.get(lname, 0.0)
+                if (
+                    n >= self.warmup
+                    and mean > 0.0
+                    and norm > self.layer_explosion_factor * mean
+                ):
+                    ev = self._event(
+                        "layer_grad_explosion", norm / mean, step
+                    )
+                    if ev:
+                        ev["layer"] = lname
+                        events.append(ev)
+                # Baseline updated AFTER the check, like the loss spike
+                # rule — an exploding flush must not vaccinate the mean
+                # it is judged against.
+                a = self.ewma_alpha
+                self._layer_mean[lname] = (
+                    norm if n == 0 else mean + a * (norm - mean)
+                )
+                self._layer_n[lname] = n + 1
+                if norm <= self.dead_layer_eps:
+                    streak = self._dead_streak.get(lname, 0) + 1
+                    self._dead_streak[lname] = streak
+                    if streak == self.dead_layer_flushes:
+                        # Fires once per streak (== not >=): a layer
+                        # that stays dead does not re-trigger every
+                        # flush; recovery resets the streak and re-arms.
+                        ev = self._event("dead_layer", norm, step)
+                        if ev:
+                            ev["layer"] = lname
+                            events.append(ev)
+                else:
+                    self._dead_streak[lname] = 0
+
         if retraces is not None and retraces > 0:
             # No detector-side warmup: the compile plane already owns
             # the warmup boundary (its first observe_flush) and only
@@ -349,8 +450,9 @@ class AnomalyDetector:
         from . import tracing as _tracing
 
         extra: dict[str, Any] = {}
-        if "function" in ev:
-            extra["function"] = ev["function"]
+        for key in ("function", "layer"):
+            if key in ev:
+                extra[key] = ev[key]
         _tracing.instant(
             "anomaly." + ev["rule"],
             rule=ev["rule"],
@@ -364,6 +466,7 @@ class AnomalyDetector:
             f"anomaly detected: {ev['rule']} (value {ev['value_repr']} at "
             f"step {ev['step']})"
             + (f" in {ev['function']}" if "function" in ev else "")
+            + (f" in layer {ev['layer']}" if "layer" in ev else "")
             + f" — policy {ev['action']!r}"
             + (
                 f"; diagnostics bundle at {self.dump_path()}"

@@ -97,10 +97,15 @@ _DEFAULT_HEALTH_DEADLINE_S = 300.0
 _PREFIX = "fluxmpi_"
 
 # The flat series a histogram instrument exposes (count/sum exactly as a
-# Prometheus summary would; min/max/mean/last are this registry's
-# bucket-free tail story). Suffixes are appended AFTER mangling, so
+# Prometheus histogram would; min/max/mean/last are this registry's
+# exact-tail story; _bucket carries the schema-declared cumulative
+# buckets — `le` labeled, +Inf included — for names with edges in
+# ``schema.HISTOGRAM_BUCKET_EDGES``, so PromQL histogram_quantile works
+# on TTFT/step-time). Suffixes are appended AFTER mangling, so
 # demangling strips them first (exposed_base_name).
-HISTOGRAM_SUFFIXES = ("_count", "_sum", "_min", "_max", "_mean", "_last")
+HISTOGRAM_SUFFIXES = (
+    "_count", "_sum", "_min", "_max", "_mean", "_last", "_bucket",
+)
 
 
 # ---------------------------------------------------------------------------
@@ -260,6 +265,27 @@ def render_prometheus(metrics: list[dict[str, Any]]) -> str:
                 put(base + "_sum", "counter", labels, m.get("sum", 0.0))
                 for stat in ("min", "max", "mean", "last"):
                     put(base + f"_{stat}", "gauge", labels, m.get(stat, 0.0))
+            buckets = m.get("buckets")
+            if isinstance(buckets, dict):
+                # Cumulative _bucket{le=...} series with the schema-
+                # declared edges (registry snapshots carry them already
+                # cumulative) plus the +Inf bucket == count — the shape
+                # PromQL histogram_quantile consumes.
+                edges = buckets.get("edges") or ()
+                counts = buckets.get("counts") or ()
+                for edge, c in zip(edges, counts):
+                    put(
+                        base + "_bucket",
+                        "counter",
+                        {**labels, "le": format(float(edge), "g")},
+                        float(c),
+                    )
+                put(
+                    base + "_bucket",
+                    "counter",
+                    {**labels, "le": "+Inf"},
+                    float(count),
+                )
     lines: list[str] = []
     emitted_type: set[str] = set()
     for (series, _), (labels, value) in values.items():
@@ -340,6 +366,7 @@ class Exporter:
         self._thread: threading.Thread | None = None
         self._status: dict[str, Any] = {}
         self._serving: dict[str, Any] = {}
+        self._model: dict[str, Any] = {}
         self._status_lock = threading.Lock()
         # Progress plateau tracking (the watchdog's check() shape,
         # evaluated lazily per health request instead of on a poll
@@ -434,10 +461,22 @@ class Exporter:
             self._serving.update(fields)
             self._serving["noted_unix"] = time.time()
 
+    def note_model(self, **fields: Any) -> None:
+        """Merge ``fields`` into the ``model`` section of ``/status`` —
+        the model-internals board (gradient noise scale / B_simple,
+        top-k layers by gradient norm, the first nonfinite layer when
+        one exists), posted by ``train_loop`` at flush boundaries when
+        the :mod:`~fluxmpi_tpu.telemetry.modelstats` plane is on.
+        ``scripts/fluxmpi_top.py`` renders it as the MODEL view."""
+        with self._status_lock:
+            self._model.update(fields)
+            self._model["noted_unix"] = time.time()
+
     def clear_status(self) -> None:
         with self._status_lock:
             self._status.clear()
             self._serving.clear()
+            self._model.clear()
 
     # -- health --------------------------------------------------------
 
@@ -531,6 +570,7 @@ class Exporter:
         with self._status_lock:
             train = dict(self._status)
             serving = dict(self._serving) or None
+            model = dict(self._model) or None
         gp = _goodput.get_goodput_tracker()
         goodput_rep = gp.report() if gp.enabled else None
         det = _anomaly.get_anomaly_detector()
@@ -561,6 +601,7 @@ class Exporter:
             "process_count": process_count,
             "train": train,
             "serving": serving,
+            "model": model,
             "goodput": goodput_rep,
             "anomaly": last_anomaly,
             "monitor": monitor,

@@ -30,9 +30,10 @@ from __future__ import annotations
 
 import threading
 import time
+from bisect import bisect_left
 from typing import Any, Iterable
 
-from .schema import SCHEMA
+from .schema import HISTOGRAM_BUCKET_EDGES, SCHEMA
 
 __all__ = [
     "Counter",
@@ -118,15 +119,23 @@ class Gauge:
 
 
 class Histogram:
-    """Running distribution summary: count/sum/min/max/last.
+    """Running distribution summary: count/sum/min/max/last, plus —
+    for the latency names with edges declared in
+    ``schema.HISTOGRAM_BUCKET_EDGES`` — fixed cumulative buckets.
 
-    Deliberately bucket-free — the consumers here ask "how slow, how
-    spread, how recent", not for quantile sketches; min/max bound the
-    tail exactly, which is what straggler detection needs.
+    The summary stats answer "how slow, how spread, how recent" and
+    min/max bound the tail exactly (what straggler detection needs);
+    the schema-declared buckets are what PromQL ``histogram_quantile``
+    needs, exposed by the live exporter as ``_bucket{le=...}`` series.
+    Names without declared edges stay bucket-free — no reservoir
+    bookkeeping, no per-producer edge invention.
     """
 
     kind = "histogram"
-    __slots__ = ("name", "labels", "count", "sum", "min", "max", "last")
+    __slots__ = (
+        "name", "labels", "count", "sum", "min", "max", "last",
+        "edges", "bins",
+    )
 
     def __init__(self, name: str, labels: dict[str, str]):
         self.name = name
@@ -136,6 +145,8 @@ class Histogram:
         self.min = float("inf")
         self.max = float("-inf")
         self.last = 0.0
+        self.edges = HISTOGRAM_BUCKET_EDGES.get(name)
+        self.bins = [0] * len(self.edges) if self.edges else None
 
     def observe(self, v: float) -> None:
         v = float(v)
@@ -146,6 +157,13 @@ class Histogram:
         if v > self.max:
             self.max = v
         self.last = v
+        if self.bins is not None:
+            # First edge >= v: the observation lands in that bin (le
+            # semantics); past the last edge it only counts toward the
+            # implicit +Inf bucket, i.e. `count`.
+            i = bisect_left(self.edges, v)
+            if i < len(self.bins):
+                self.bins[i] += 1
 
     @property
     def mean(self) -> float:
@@ -163,6 +181,16 @@ class Histogram:
                 sum=self.sum, min=self.min, max=self.max,
                 mean=self.mean, last=self.last,
             )
+        if self.bins is not None:
+            # Cumulative counts, Prometheus-shaped: counts[i] = samples
+            # <= edges[i]; the +Inf bucket is `count` (rendered by the
+            # exporter, not duplicated here).
+            cum: list[int] = []
+            running = 0
+            for n in self.bins:
+                running += n
+                cum.append(running)
+            out["buckets"] = {"edges": list(self.edges), "counts": cum}
         return out
 
 

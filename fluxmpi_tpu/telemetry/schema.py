@@ -165,6 +165,24 @@ KNOWN_METRIC_NAMES = frozenset(
         "serving.tokens_generated",
         "serving.kv_blocks_in_use",
         "serving.kv_blocks_free",
+        # Model-internals plane (PR 14): per-layer training dynamics
+        # computed INSIDE the compiled step (telemetry/modelstats.py) and
+        # emitted at train_loop flush boundaries — per-layer gradient /
+        # parameter norms and the update-to-weight ratio ({layer=...},
+        # grouped by path depth so the set stays O(layers)), the
+        # per-layer nonfinite-gradient element count (NaN provenance),
+        # and the gradient-noise-scale ingredients the DP allreduce
+        # produces for free: the mean per-rank (pre-allreduce) gradient
+        # sq-norm, the averaged gradient's sq-norm, and the B_simple
+        # critical-batch-size estimate derived from them (McCandlish et
+        # al. 2018).
+        "model.layer_grad_norm",
+        "model.layer_param_norm",
+        "model.update_ratio",
+        "model.nonfinite",
+        "model.grad_sqnorm_local",
+        "model.grad_sqnorm_global",
+        "model.grad_noise_scale",
     }
 )
 
@@ -177,7 +195,36 @@ _CLOSED_NAMESPACES = (
     "memory.",
     "export.",
     "serving.",
+    "model.",
 )
+
+# Histogram bucket edges, declared HERE so the registry (which bins
+# observations), the Prometheus exporter (which renders cumulative
+# ``_bucket{le=...}`` series), and any JSONL consumer all agree on one
+# set of boundaries — PromQL ``histogram_quantile`` needs cumulative
+# buckets, and an edge set invented per producer would make cross-host
+# aggregation meaningless. Names absent here keep the bucket-free
+# count/sum/min/max/mean/last summary (min/max bound the tail exactly,
+# which is what straggler detection needs).
+_LATENCY_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+# Eager-collective host blocking and per-token decode sit well under a
+# millisecond on healthy hardware — extend the ladder down so the fast
+# path isn't one undifferentiated first bucket.
+_FAST_LATENCY_BUCKETS = (1e-05, 2.5e-05, 5e-05, 0.0001, 0.00025) + (
+    _LATENCY_BUCKETS
+)
+
+HISTOGRAM_BUCKET_EDGES: dict[str, tuple[float, ...]] = {
+    "train.step_seconds": _LATENCY_BUCKETS,
+    "data.batch_fetch_seconds": _LATENCY_BUCKETS,
+    "comm.block_seconds": _FAST_LATENCY_BUCKETS,
+    "serving.ttft_seconds": _LATENCY_BUCKETS,
+    "serving.token_seconds": _FAST_LATENCY_BUCKETS,
+    "serving.queue_wait_seconds": _LATENCY_BUCKETS,
+}
 
 # The preemption trace event train_loop emits when it drains and exits on
 # SIGTERM/SIGINT: an instant ("i"/"I") carrying the update count it
@@ -281,6 +328,53 @@ def validate_metric(m: object, where: str = "metric") -> list[str]:
             for k in _HIST_STAT_KEYS:
                 if not _is_number(m.get(k)):
                     errors.append(f"{where}: histogram missing numeric {k!r}")
+        errors.extend(_validate_histogram_buckets(m, where))
+    return errors
+
+
+def _validate_histogram_buckets(m: dict, where: str) -> list[str]:
+    """Optional cumulative buckets on a histogram metric object:
+    ``{"edges": [...], "counts": [...]}`` with strictly increasing
+    edges, same-length non-decreasing int counts, and the last count
+    bounded by the total ``count`` (the implicit ``+Inf`` bucket)."""
+    buckets = m.get("buckets")
+    if buckets is None:
+        return []
+    if not isinstance(buckets, dict):
+        return [f"{where}: 'buckets' must be an object, got {buckets!r}"]
+    errors: list[str] = []
+    edges = buckets.get("edges")
+    counts = buckets.get("counts")
+    if not isinstance(edges, list) or not all(_is_number(e) for e in edges):
+        errors.append(f"{where}: buckets 'edges' must be a list of numbers")
+        edges = []
+    elif any(b <= a for a, b in zip(edges, edges[1:])):
+        errors.append(f"{where}: buckets 'edges' must be strictly increasing")
+    if not isinstance(counts, list) or not all(
+        isinstance(c, int) and not isinstance(c, bool) and c >= 0
+        for c in counts
+    ):
+        errors.append(
+            f"{where}: buckets 'counts' must be a list of ints >= 0"
+        )
+        counts = []
+    else:
+        if any(b < a for a, b in zip(counts, counts[1:])):
+            errors.append(
+                f"{where}: buckets 'counts' must be cumulative "
+                f"(non-decreasing)"
+            )
+        total = m.get("count")
+        if counts and isinstance(total, int) and counts[-1] > total:
+            errors.append(
+                f"{where}: last bucket count {counts[-1]} exceeds total "
+                f"'count' {total} (the implicit +Inf bucket)"
+            )
+    if edges and counts and len(edges) != len(counts):
+        errors.append(
+            f"{where}: buckets edges/counts length mismatch "
+            f"({len(edges)} vs {len(counts)})"
+        )
     return errors
 
 
@@ -357,7 +451,7 @@ def validate_status_record(rec: object) -> list[str]:
     for key in ("train", "monitor", "watchdog"):
         if not isinstance(rec.get(key), dict):
             errors.append(f"'{key}' must be an object")
-    for key in ("goodput", "anomaly", "serving"):
+    for key in ("goodput", "anomaly", "serving", "model"):
         v = rec.get(key)
         if v is not None and not isinstance(v, dict):
             errors.append(f"'{key}' must be null or an object")

@@ -15,6 +15,11 @@ anomaly ticker of the most recent triggers fleet-wide:
     tpu-host-1       9600     80.9  0.0312    90.8%  0.409    2.3s  ok
     anomalies: (none)
 
+Hosts running the model-internals plane (``init(model_stats=True)``)
+additionally get a MODEL block — gradient noise scale (B_simple) and
+the top-k layers by gradient norm, with a NONFINITE ticker naming the
+offending layer when NaN provenance fired; the anomaly ticker renders
+the triggering event's labels (layer / function), not just the rule id.
 Hosts running the serving plane (``fluxmpi_tpu.serving``) additionally
 get a SERVING block — active/queued requests, live decode step rate,
 token counter, KV block utilization, completions/rejects, and an
@@ -263,6 +268,40 @@ def _serving_rows(
     return rows
 
 
+def _model_rows(statuses: dict[str, Any]) -> list[str]:
+    """The MODEL block: one row per host whose ``/status`` carries a
+    ``model`` board (the model-internals plane posts it at flush
+    boundaries) — gradient noise scale (B_simple) and the top-k layers
+    by gradient norm, plus a nonfinite-layer ticker when NaN provenance
+    fired."""
+    rows: list[str] = []
+    tickers: list[str] = []
+    for name, status in statuses.items():
+        board = (status or {}).get("model")
+        if not isinstance(board, dict):
+            continue
+        if not rows:
+            rows.append(f"{'MODEL':<18}{'NOISE B':>9}  TOP LAYERS BY GRAD NORM")
+        ns = board.get("noise_scale")
+        top = board.get("top")
+        top_str = "-"
+        if isinstance(top, list) and top:
+            top_str = "  ".join(
+                f"{t.get('layer')}={_fmt(t.get('grad_norm'), '.3g')}"
+                for t in top
+                if isinstance(t, dict)
+            )
+        rows.append(f"{name:<18}{_fmt(ns, '>9.3g'):>9}  {top_str}")
+        bad = board.get("nonfinite_layer")
+        if isinstance(bad, str) and bad:
+            tickers.append(
+                f"  {name}: NONFINITE gradients in {bad} "
+                f"(step {board.get('step')})"
+            )
+    rows.extend(tickers)
+    return rows
+
+
 def render_frame(
     statuses: dict[str, dict[str, Any] | None],
     rates: dict[str, tuple[float, float]],
@@ -296,13 +335,23 @@ def render_frame(
     for name, s in statuses.items():
         ev = (s or {}).get("anomaly")
         if isinstance(ev, dict) and ev.get("rule"):
+            # The triggering event's labels, not just the rule id: a
+            # steady_state_retrace names the recompiled function, the
+            # model-internals rules (and NaN provenance) name the layer
+            # — the "which" an operator otherwise digs out of bundles.
+            detail = "".join(
+                f" {key}={ev[key]}"
+                for key in ("layer", "function")
+                if isinstance(ev.get(key), str) and ev.get(key)
+            )
             tickers.append(
-                f"  {name}: {ev['rule']} "
+                f"  {name}: {ev['rule']}{detail} "
                 f"(value {ev.get('value_repr', ev.get('value'))} "
                 f"at step {ev.get('step')})"
             )
     lines.append("anomalies:" + (" (none)" if not tickers else ""))
     lines.extend(tickers)
+    lines.extend(_model_rows(statuses))
     lines.extend(_serving_rows(statuses, rates))
     return "\n".join(lines)
 

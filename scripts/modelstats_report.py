@@ -1,0 +1,344 @@
+#!/usr/bin/env python
+"""Per-layer training-dynamics report from telemetry JSONL streams.
+
+Reads the per-host JSONL files a run emitted (``init(telemetry=...)``
+with the model-internals plane on — ``init(model_stats=True)`` /
+``FLUXMPI_TPU_MODEL_STATS=1``), takes each process's LAST record
+carrying ``model.*`` metrics (the gauges describe the newest flush),
+and prints the per-layer view the plane exists for — which layers carry
+the gradient signal, whether the update-to-weight ratios are in the
+healthy band, where nonfinite gradients first appeared, and the
+gradient noise scale (B_simple, McCandlish et al. 2018) with its
+critical-batch-size reading:
+
+    $ python scripts/modelstats_report.py run.*.jsonl
+    host 0: 3 layer group(s), step data from the last flush
+      LAYER                      GRAD NORM   PARAM NORM   UPD/WEIGHT  NONFIN
+      params/dense_1               0.412        3.21        2.1e-03       0
+      params/dense_0               0.307        2.88        1.8e-03       0
+      params/dense_2               0.101        1.09        9.9e-04       0
+      noise scale B_simple ~ 1.6e+00  (last flush; ingredients below)
+        E|g_rank|^2 19.48  |g_mean|^2 16.67
+    run: 1 host stream(s), 3 layer group(s)
+
+The history mode (``--history``) additionally aggregates over EVERY
+record in the bank: the mean of the two noise-scale *ingredients*
+(``model.grad_sqnorm_{local,global}``) and a B_simple recomputed from
+those means — single-flush B_simple estimates are noisy by construction
+(and the derived gauge is absent on flushes where the estimators landed
+outside their valid region, so a mean of the per-flush values would be
+a biased survivor-sample mean-of-ratios); averaging the ingredients
+first is the stable reading to size a batch against. Deriving B_simple
+from the ingredient means needs the run geometry the bank does not
+carry — pass ``--batch`` (global batch size) and ``--workers`` (DP
+width) and history mode prints it; without them it prints the mean
+ingredients and their ratio. The per-flush estimate history
+(last/mean/count) is shown alongside for reference.
+
+Usage:
+    python scripts/modelstats_report.py FILE [FILE ...] [--json]
+                                        [--top N] [--history]
+                                        [--batch N --workers W]
+
+``--top N`` limits the per-layer table to the N largest gradient norms
+(default: all). ``--json`` prints one machine-readable JSON object.
+
+Exit codes: 0 = model.* data found and reported; 1 = inputs readable
+but NO model metrics anywhere (the plane was off — nothing to report);
+2 = a file was missing/unreadable. Torn/corrupt LINES are skipped with
+a stderr warning, never fatal (the goodput_report contract).
+
+Stdlib-only, no jax, no package import — runnable anywhere the JSONL
+landed (same contract as scripts/check_metrics_schema.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any
+
+
+def _extract_model(record: dict) -> dict[str, Any] | None:
+    """Pull the model.* gauges out of one telemetry flush record; None
+    when the record carries none (the plane was off at that flush)."""
+    metrics = record.get("metrics")
+    if not isinstance(metrics, list):
+        return None
+    layers: dict[str, dict[str, float]] = {}
+    scalars: dict[str, float] = {}
+    found = False
+    for m in metrics:
+        if not isinstance(m, dict):
+            continue
+        name = m.get("name")
+        if not isinstance(name, str) or not name.startswith("model."):
+            continue
+        value = m.get("value")
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            continue
+        found = True
+        layer = (m.get("labels") or {}).get("layer")
+        if isinstance(layer, str) and layer:
+            slot = layers.setdefault(layer, {})
+            if name == "model.layer_grad_norm":
+                slot["grad_norm"] = float(value)
+            elif name == "model.layer_param_norm":
+                slot["param_norm"] = float(value)
+            elif name == "model.update_ratio":
+                slot["update_ratio"] = float(value)
+            elif name == "model.nonfinite":
+                slot["nonfinite"] = float(value)
+        elif name in (
+            "model.grad_sqnorm_local",
+            "model.grad_sqnorm_global",
+            "model.grad_noise_scale",
+        ):
+            scalars[name.split(".", 1)[1]] = float(value)
+    if not found:
+        return None
+    return {"layers": layers, "scalars": scalars}
+
+
+def parse_banks(
+    paths: list[str],
+) -> tuple[dict[int, dict[str, Any]], dict[int, dict[str, list]], list[str]]:
+    """(last model view per process, per-process noise histories —
+    ``{"estimates": [...], "local": [...], "global": [...]}`` — fatal
+    errors). Torn lines warn to stderr and are skipped."""
+    last: dict[int, dict[str, Any]] = {}
+    history: dict[int, dict[str, list]] = {}
+    errors: list[str] = []
+    for path in paths:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                content = f.read()
+        except OSError as exc:
+            errors.append(f"{path}: {exc}")
+            continue
+        for i, line in enumerate(content.splitlines(), 1):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                print(
+                    f"warning: {path}:{i}: skipping torn/corrupt line",
+                    file=sys.stderr,
+                )
+                continue
+            if not isinstance(rec, dict):
+                continue
+            view = _extract_model(rec)
+            if view is None:
+                continue
+            proc = rec.get("process")
+            proc = proc if isinstance(proc, int) else 0
+            view["time_unix"] = rec.get("time_unix")
+            last[proc] = view
+            hist = history.setdefault(
+                proc, {"estimates": [], "local": [], "global": []}
+            )
+            scalars = view["scalars"]
+            ns = scalars.get("grad_noise_scale")
+            if ns is not None:
+                hist["estimates"].append(ns)
+            if (
+                "grad_sqnorm_local" in scalars
+                and "grad_sqnorm_global" in scalars
+            ):
+                # The INGREDIENTS are present on every noise-carrying
+                # flush — including the ones where the derived estimate
+                # was undefined — so their means are the unbiased,
+                # uncensored aggregate.
+                hist["local"].append(scalars["grad_sqnorm_local"])
+                hist["global"].append(scalars["grad_sqnorm_global"])
+    return last, history, errors
+
+
+def _fmt(v: Any, spec: str, dash: str = "-") -> str:
+    if v is None:
+        return dash
+    try:
+        return format(v, spec)
+    except (TypeError, ValueError):
+        return dash
+
+
+def _b_simple(
+    local_sq: float, global_sq: float, batch: int, workers: int
+) -> float | None:
+    """B_simple from the two gradient sq-norms (the stdlib twin of
+    ``fluxmpi_tpu.telemetry.modelstats.noise_scale`` — this script must
+    not import the package): tr(Sigma)/|G|^2 via the McCandlish
+    two-batch-size estimators."""
+    if workers <= 1 or batch <= 0:
+        return None
+    b_big = float(batch)
+    b_small = b_big / float(workers)
+    g2 = (b_big * global_sq - b_small * local_sq) / (b_big - b_small)
+    trace_sigma = (local_sq - global_sq) / (1.0 / b_small - 1.0 / b_big)
+    if g2 <= 0.0 or trace_sigma < 0.0:
+        return None
+    return trace_sigma / g2
+
+
+def render(
+    last: dict[int, dict[str, Any]],
+    history: dict[int, dict[str, list]],
+    top: int | None,
+    show_history: bool,
+    batch: int | None = None,
+    workers: int | None = None,
+) -> str:
+    lines: list[str] = []
+    all_layers: set[str] = set()
+    for proc in sorted(last):
+        view = last[proc]
+        layers = view["layers"]
+        all_layers.update(layers)
+        lines.append(
+            f"host {proc}: {len(layers)} layer group(s), "
+            f"stats from the last flush"
+        )
+        lines.append(
+            f"  {'LAYER':<28}{'GRAD NORM':>11} {'PARAM NORM':>11} "
+            f"{'UPD/WEIGHT':>11} {'NONFIN':>7}"
+        )
+        ranked = sorted(
+            layers.items(),
+            key=lambda kv: kv[1].get("grad_norm", 0.0),
+            reverse=True,
+        )
+        if top is not None:
+            ranked = ranked[:top]
+        for name, st in ranked:
+            bad = st.get("nonfinite", 0.0)
+            flag = "  <-- NONFINITE" if bad else ""
+            lines.append(
+                f"  {name:<28}"
+                f"{_fmt(st.get('grad_norm'), '>11.4g')} "
+                f"{_fmt(st.get('param_norm'), '>11.4g')} "
+                f"{_fmt(st.get('update_ratio'), '>11.3g')} "
+                f"{_fmt(bad, '>7.0f')}{flag}"
+            )
+        scalars = view["scalars"]
+        ns = scalars.get("grad_noise_scale")
+        if ns is not None:
+            lines.append(
+                f"  noise scale B_simple ~ {ns:.3g}  "
+                f"(last flush; single-step estimates are noisy)"
+            )
+        if "grad_sqnorm_local" in scalars:
+            lines.append(
+                f"    E|g_rank|^2 {scalars['grad_sqnorm_local']:.4g}  "
+                f"|g_mean|^2 {scalars.get('grad_sqnorm_global', 0.0):.4g}"
+            )
+        if show_history and history.get(proc):
+            hist = history[proc]
+            if hist["local"]:
+                # The unbiased aggregate: INGREDIENT means over every
+                # noise-carrying flush (estimate-less flushes included),
+                # turned into B_simple when the run geometry is known.
+                mean_l = sum(hist["local"]) / len(hist["local"])
+                mean_g = sum(hist["global"]) / len(hist["global"])
+                line = (
+                    f"  ingredient means over {len(hist['local'])} "
+                    f"flush(es): E|g_rank|^2 {mean_l:.4g}  "
+                    f"|g_mean|^2 {mean_g:.4g}  ratio {mean_l / mean_g:.3g}"
+                    if mean_g > 0
+                    else f"  ingredient means over {len(hist['local'])} "
+                    f"flush(es): E|g_rank|^2 {mean_l:.4g}  |g_mean|^2 0"
+                )
+                lines.append(line)
+                if batch and workers:
+                    b_mean = _b_simple(mean_l, mean_g, batch, workers)
+                    lines.append(
+                        f"  B_simple from ingredient means "
+                        f"(batch={batch}, workers={workers}): "
+                        + (f"{b_mean:.3g}" if b_mean is not None
+                           else "undefined (|G|^2 estimate <= 0)")
+                    )
+            est = hist["estimates"]
+            if est:
+                lines.append(
+                    f"  per-flush estimate history (None-censored): "
+                    f"last {est[-1]:.3g}  mean {sum(est) / len(est):.3g}  "
+                    f"n={len(est)}"
+                )
+    lines.append(
+        f"run: {len(last)} host stream(s), {len(all_layers)} layer group(s)"
+    )
+    return "\n".join(lines)
+
+
+def main(argv: list[str]) -> int:
+    parser = argparse.ArgumentParser(
+        description="Per-layer gradient/update statistics and gradient "
+        "noise scale from telemetry JSONL streams (the model-internals "
+        "plane, init(model_stats=True))."
+    )
+    parser.add_argument("files", nargs="+", help="telemetry JSONL file(s)")
+    parser.add_argument(
+        "--json", action="store_true",
+        help="print one machine-readable JSON object instead of the table",
+    )
+    parser.add_argument(
+        "--top", type=int, default=None, metavar="N",
+        help="show only the N layers with the largest gradient norms",
+    )
+    parser.add_argument(
+        "--history", action="store_true",
+        help="aggregate the noise-scale ingredients over every record "
+        "in the bank",
+    )
+    parser.add_argument(
+        "--batch", type=int, default=None, metavar="N",
+        help="global batch size of the run — with --workers, history "
+        "mode derives B_simple from the ingredient means",
+    )
+    parser.add_argument(
+        "--workers", type=int, default=None, metavar="W",
+        help="data-parallel width of the run (see --batch)",
+    )
+    args = parser.parse_args(argv)
+    if args.top is not None and args.top < 1:
+        parser.error("--top must be >= 1")
+    if bool(args.batch) != bool(args.workers):
+        parser.error("--batch and --workers go together")
+
+    last, history, errors = parse_banks(args.files)
+    for err in errors:
+        print(f"error: {err}", file=sys.stderr)
+    if errors:
+        return 2
+    if not last:
+        print(
+            "no model.* metrics found — was the model-internals plane on? "
+            "(init(model_stats=True) / FLUXMPI_TPU_MODEL_STATS=1)",
+            file=sys.stderr,
+        )
+        return 1
+    if args.json:
+        out = {
+            "hosts": {
+                str(proc): last[proc] for proc in sorted(last)
+            },
+            "noise_history": {
+                str(proc): history[proc] for proc in sorted(history)
+            },
+        }
+        print(json.dumps(out))
+    else:
+        print(
+            render(
+                last, history, args.top, args.history,
+                batch=args.batch, workers=args.workers,
+            )
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -116,12 +116,25 @@ def test_demangle_rejects_foreign_series():
 
 def test_exposed_base_name_strips_histogram_suffixes():
     base = mangle_name("train.step_seconds")
-    for suffix in ("_count", "_sum", "_min", "_max", "_mean", "_last"):
+    for suffix in (
+        "_count", "_sum", "_min", "_max", "_mean", "_last", "_bucket",
+    ):
         assert exposed_base_name(base + suffix) == "train.step_seconds"
     # A plain gauge whose name merely ends like a suffix stays itself.
     assert exposed_base_name(mangle_name("goodput.updates")) == (
         "goodput.updates"
     )
+
+
+def test_bucket_suffix_round_trips_every_bucketed_name():
+    """The _bucket series of every edge-declared histogram demangles
+    back to its schema name (the quantile series must validate through
+    the same closed-namespace smoke as every other)."""
+    from fluxmpi_tpu.telemetry.schema import HISTOGRAM_BUCKET_EDGES
+
+    for name in HISTOGRAM_BUCKET_EDGES:
+        assert name in KNOWN_METRIC_NAMES
+        assert exposed_base_name(mangle_name(name) + "_bucket") == name
 
 
 # ---------------------------------------------------------------------------
@@ -145,6 +158,12 @@ def test_render_prometheus_kinds_and_labels():
     assert "fluxmpi_train_step__seconds_count 2" in text
     assert "fluxmpi_train_step__seconds_sum 1" in text
     assert "fluxmpi_train_step__seconds_max 0.75" in text
+    # Schema-declared buckets render as cumulative _bucket{le} series
+    # with the +Inf terminator — the histogram_quantile() shape.
+    assert '# TYPE fluxmpi_train_step__seconds_bucket counter' in text
+    assert 'fluxmpi_train_step__seconds_bucket{le="0.25"} 1' in text
+    assert 'fluxmpi_train_step__seconds_bucket{le="1"} 2' in text
+    assert 'fluxmpi_train_step__seconds_bucket{le="+Inf"} 2' in text
     # One TYPE line per family even with several label sets.
     reg.counter("comm.calls", op="bcast", path="device").inc()
     text = render_prometheus(reg.snapshot())
@@ -489,6 +508,18 @@ def _check_anomaly():
     assert telemetry.get_anomaly_detector() is None
 
 
+def _arm_modelstats(tmp_path):
+    from fluxmpi_tpu.telemetry import modelstats as modelstats_mod
+
+    modelstats_mod.configure(True)
+
+
+def _check_modelstats():
+    from fluxmpi_tpu.telemetry import modelstats as modelstats_mod
+
+    assert modelstats_mod.get_model_stats() is None
+
+
 def _arm_compileplane(tmp_path):
     from fluxmpi_tpu.telemetry import compileplane as compileplane_mod
 
@@ -565,6 +596,7 @@ _PLANES = [
     ("watchdog", _arm_watchdog, _check_watchdog),
     ("goodput", _arm_goodput, _check_goodput),
     ("anomaly", _arm_anomaly, _check_anomaly),
+    ("modelstats", _arm_modelstats, _check_modelstats),
     ("compileplane", _arm_compileplane, _check_compileplane),
     ("memory", _arm_memory, _check_memory),
     ("profiler", _arm_profiler, _check_profiler),

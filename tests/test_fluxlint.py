@@ -316,6 +316,66 @@ def test_metric_rule_checks_instant_names():
     assert r.findings == []
 
 
+def test_metric_rule_flags_consumer_literal_drift_in_scripts():
+    """The consumer half: dashboards under scripts/ read metric keys as
+    PLAIN string literals — a drifted key must fail the lint, not fail
+    as a silently blank panel at runtime."""
+    src = textwrap.dedent(
+        """
+        def f(flat):
+            return flat.get("train.losss")
+        """
+    )
+    r = lint_source(src, "scripts/top.py", _ctx(), rules=[UnknownMetricName()])
+    (f,) = r.findings
+    assert f.key == "train.losss"
+    assert "train.loss" in f.message  # nearest-known hint
+    # The SAME literal outside scripts/ is not a consumer read (package
+    # producers go through the instrument-call check instead).
+    r = lint_source(src, "pkg/m.py", _ctx(), rules=[UnknownMetricName()])
+    assert r.findings == []
+
+
+def test_metric_rule_consumer_scan_allows_known_shapes():
+    src = textwrap.dedent(
+        '''
+        """Docstring naming train.losss is prose, not a read."""
+
+        def f(flat, name):
+            a = flat.get("train.loss")           # schema-known
+            b = name.startswith("fault.")        # family-prefix idiom
+            c = flat.get("not.a.metric.family")  # foreign dotted string
+            d = open("some.file.json")           # ditto
+            e = flat.get("train.preemption")     # the instant constant
+            return a, b, c, d, e
+        '''
+    )
+    r = lint_source(src, "scripts/top.py", _ctx(), rules=[UnknownMetricName()])
+    assert r.findings == []
+
+
+def test_metric_rule_consumer_scan_flags_dead_family_prefix():
+    # A dangling "<family>." prefix read matching NOTHING known under it
+    # is drift too (ctx has no metric under "train." besides
+    # train.loss, so "fault.zzz_" style reads flag via the family).
+    src = 'def f(flat):\n    return flat.get("fault.zzz")\n'
+    r = lint_source(src, "scripts/top.py", _ctx(), rules=[UnknownMetricName()])
+    assert _keys(r, "unknown-metric-name") == ["fault.zzz"]
+    # ...including the trailing-dot form: a startswith("train.loss.")
+    # read (sub-namespace typo) matches nothing known and must flag,
+    # while a live family prefix stays quiet.
+    src = textwrap.dedent(
+        """
+        def f(name):
+            a = name.startswith("train.loss.")
+            b = name.startswith("fault.injected")
+            return a, b
+        """
+    )
+    r = lint_source(src, "scripts/top.py", _ctx(), rules=[UnknownMetricName()])
+    assert _keys(r, "unknown-metric-name") == ["prefix:train.loss."]
+
+
 # ---------------------------------------------------------------------------
 # Rule 4: unregistered-fault-site
 # ---------------------------------------------------------------------------

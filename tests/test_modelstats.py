@@ -1,0 +1,861 @@
+"""Model-internals plane tests (telemetry/modelstats.py + the in-jit
+collection in parallel/train.py + the train_loop flush wiring):
+grouping/stat math against numpy oracles, trajectory invariance
+(bit-identical on/off, both drivers), pipelined-vs-fused stat equality,
+sharded-param-tree (FSDP) norms against a replicated oracle, the
+shard_map gradient noise scale, NaN provenance end to end (event +
+instant + bundle + schema CLI), the new anomaly layer rules, the
+zero-cost-when-off explode contract, configure/env forms, the /status
+MODEL board + fluxmpi_top rendering, and the modelstats_report CLI."""
+
+import importlib.util
+import json
+import math
+import os
+import subprocess
+import sys
+import urllib.request
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fluxmpi_tpu import telemetry
+from fluxmpi_tpu.data import ArrayDataset, DistributedDataLoader
+from fluxmpi_tpu.models import MLP
+from fluxmpi_tpu.parallel import TrainState, make_train_step, train_loop
+from fluxmpi_tpu.parallel.train import replicate
+from fluxmpi_tpu.telemetry import (
+    AnomalyDetector,
+    JSONLSink,
+    MetricsRegistry,
+    ModelStats,
+    anomaly,
+    export,
+    get_registry,
+    modelstats,
+)
+from fluxmpi_tpu.telemetry import schema as tschema
+from fluxmpi_tpu.telemetry.modelstats import (
+    compute_stats,
+    group_paths,
+    noise_scale,
+    resolve_step_spec,
+    stats_zeros,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_CHECKER = os.path.join(_REPO, "scripts", "check_metrics_schema.py")
+_REPORT = os.path.join(_REPO, "scripts", "modelstats_report.py")
+_TOP = os.path.join(_REPO, "scripts", "fluxmpi_top.py")
+
+
+@pytest.fixture()
+def ms_off():
+    """Guarantee the model-internals plane (and the anomaly detector it
+    feeds) is off around a test, restoring whatever was installed."""
+    prev = modelstats.set_model_stats(None)
+    prev_det = anomaly.set_anomaly_detector(None)
+    try:
+        yield
+    finally:
+        modelstats.set_model_stats(prev)
+        anomaly.set_anomaly_detector(prev_det)
+
+
+def _mlp_pieces(n=256, features=(8, 8, 1), poison_layer=None, poison_from=None):
+    """Loss/opt/params/dataset for a small MLP. With ``poison_layer``,
+    a custom_vjp injects NaN into EXACTLY that layer's kernel gradient
+    once a sentinel batch (x > 100) flows — the loss and every other
+    layer's gradient stay finite, which is the provenance scenario (a
+    NaN *input* would poison every layer through backprop)."""
+    model = MLP(features=features)
+
+    @jax.custom_vjp
+    def _poison(x, flag):
+        return x
+
+    def _poison_fwd(x, flag):
+        return x, flag
+
+    def _poison_bwd(flag, g):
+        return (
+            jnp.where(flag, jnp.full_like(g, jnp.nan), g),
+            None,
+        )
+
+    _poison.defvjp(_poison_fwd, _poison_bwd)
+
+    def loss_fn(p, mstate, b):
+        bx, by = b
+        if poison_layer is not None:
+            flag = jnp.any(bx > 100.0)
+            inner = dict(p["params"])
+            slot = dict(inner[poison_layer])
+            slot["kernel"] = _poison(slot["kernel"], flag)
+            inner[poison_layer] = slot
+            p = {"params": inner}
+            # Keep the FORWARD finite even on the sentinel batch: the
+            # NaN must exist only in one layer's gradient.
+            bx = jnp.where(jnp.abs(bx) > 100.0, 0.0, bx)
+        return jnp.mean((model.apply(p, bx) - by) ** 2), mstate
+
+    opt = optax.adam(1e-3)
+    rng = np.random.default_rng(0)
+    x = rng.uniform(-2, 2, size=(n, 1)).astype(np.float32)
+    y = (x**2).astype(np.float32)
+    if poison_from is not None:
+        x[poison_from] = 1000.0  # the sentinel the poison flag keys on
+    params = jax.device_get(
+        model.init(jax.random.PRNGKey(0), np.zeros((2, 1), np.float32))
+    )
+    return loss_fn, opt, params, ArrayDataset((x, y))
+
+
+# ---------------------------------------------------------------------------
+# Grouping + stat math (numpy oracles, no train loop)
+# ---------------------------------------------------------------------------
+
+
+def test_group_paths_depth_controls_granularity():
+    tree = {
+        "params": {
+            "dense_0": {"kernel": jnp.ones((2, 2)), "bias": jnp.ones((2,))},
+            "dense_1": {"kernel": jnp.ones((2, 1))},
+        }
+    }
+    depth2 = group_paths(tree, 2)
+    assert sorted(depth2) == ["params/dense_0", "params/dense_1"]
+    assert len(depth2["params/dense_0"]) == 2  # kernel + bias leaves
+    depth1 = group_paths(tree, 1)
+    assert sorted(depth1) == ["params"]
+    depth9 = group_paths(tree, 9)  # deeper than the tree: one per leaf
+    assert len(depth9) == 3
+    with pytest.raises(ValueError, match="depth"):
+        group_paths(tree, 0)
+
+
+def test_compute_stats_matches_numpy_oracle():
+    rng = np.random.default_rng(3)
+    params = {
+        "params": {
+            "a": {"kernel": rng.normal(size=(3, 4)).astype(np.float32)},
+            "b": {"kernel": rng.normal(size=(4, 2)).astype(np.float32)},
+        }
+    }
+    grads = jax.tree_util.tree_map(
+        lambda p: np.asarray(rng.normal(size=p.shape), np.float32), params
+    )
+    updates = jax.tree_util.tree_map(
+        lambda p: np.asarray(rng.normal(size=p.shape), np.float32), params
+    )
+    grads["params"]["b"]["kernel"][0, 0] = np.nan
+    grads["params"]["b"]["kernel"][1, 1] = np.inf
+    stats = jax.device_get(
+        compute_stats(grads, params, updates, depth=2)
+    )
+    for group, sub in (("params/a", "a"), ("params/b", "b")):
+        g = grads["params"][sub]["kernel"]
+        assert float(stats["layers"][group]["param_norm"]) == pytest.approx(
+            float(np.linalg.norm(params["params"][sub]["kernel"])), rel=1e-6
+        )
+        assert float(stats["layers"][group]["update_norm"]) == pytest.approx(
+            float(np.linalg.norm(updates["params"][sub]["kernel"])), rel=1e-6
+        )
+        if sub == "a":
+            assert float(stats["layers"][group]["grad_norm"]) == pytest.approx(
+                float(np.linalg.norm(g)), rel=1e-6
+            )
+    assert float(stats["layers"]["params/a"]["nonfinite"]) == 0.0
+    assert float(stats["layers"]["params/b"]["nonfinite"]) == 2.0
+    assert not math.isfinite(float(stats["layers"]["params/b"]["grad_norm"]))
+    # The zeros builder mirrors the structure exactly (the fused window
+    # carry-init contract).
+    zeros = stats_zeros(params, depth=2)
+    assert jax.tree_util.tree_structure(zeros) == (
+        jax.tree_util.tree_structure(jax.device_get(stats))
+    )
+
+
+def test_noise_scale_algebra_and_degenerate_cases():
+    # Hand-checkable: B_small=8, B_big=64, |G|^2=4, tr(Sigma)=160:
+    # E|g_small|^2 = 4 + 160/8 = 24 ; |g_big|^2 = 4 + 160/64 = 6.5
+    b_simple = noise_scale(24.0, 6.5, batch_examples=64, workers=8)
+    assert b_simple == pytest.approx(160.0 / 4.0)
+    # Degenerate: one worker (no local/global split), bad batch, |G|^2
+    # estimate <= 0 (noise dominated), tr(Sigma) < 0 — all None, never
+    # a crash or a garbage negative estimate.
+    assert noise_scale(24.0, 6.5, batch_examples=64, workers=1) is None
+    assert noise_scale(24.0, 6.5, batch_examples=0, workers=8) is None
+    assert noise_scale(100.0, 1.0, batch_examples=64, workers=8) is None
+    assert noise_scale(1.0, 2.0, batch_examples=64, workers=8) is None
+    assert noise_scale(float("nan"), 1.0, batch_examples=64, workers=8) is None
+
+
+def test_observe_flush_emits_and_summarizes(ms_off):
+    plane = ModelStats(depth=2, top_k=2)
+    reg = MetricsRegistry()
+    stats = {
+        "layers": {
+            "params/a": {
+                "grad_norm": 1.0, "param_norm": 4.0,
+                "update_norm": 0.2, "nonfinite": 0.0,
+            },
+            "params/b": {
+                "grad_norm": 3.0, "param_norm": 2.0,
+                "update_norm": 0.1, "nonfinite": 2.0,
+            },
+            "params/c": {
+                "grad_norm": 2.0, "param_norm": 0.0,
+                "update_norm": 0.0, "nonfinite": 0.0,
+            },
+        },
+        "noise": {"local_sqnorm": 24.0, "global_sqnorm": 6.5},
+    }
+    summary = plane.observe_flush(
+        stats, step=10, registry=reg, batch_examples=64, workers=8
+    )
+    assert summary["layers"]["params/b"] == 3.0
+    assert summary["update_ratios"]["params/a"] == pytest.approx(0.05)
+    assert summary["update_ratios"]["params/c"] == 0.0  # zero-weight guard
+    assert summary["nonfinite_layer"] == "params/b"
+    assert summary["nonfinite_total"] == 2
+    assert summary["noise_scale"] == pytest.approx(40.0)
+    assert [name for name, _ in summary["top"]] == ["params/b", "params/c"]
+    assert reg.gauge("model.layer_grad_norm", layer="params/b").value == 3.0
+    assert reg.gauge("model.update_ratio", layer="params/a").value == (
+        pytest.approx(0.05)
+    )
+    assert reg.gauge("model.nonfinite", layer="params/b").value == 2.0
+    assert reg.gauge("model.grad_noise_scale").value == pytest.approx(40.0)
+    # Disabled registry: summary still computed, nothing recorded.
+    reg2 = MetricsRegistry()
+    reg2.enabled = False
+    plane.observe_flush(stats, registry=reg2)
+    assert not any(
+        m["name"].startswith("model.") for m in reg2.snapshot()
+    )
+
+
+def test_resolve_step_spec_forms(ms_off):
+    assert resolve_step_spec(None) is None  # plane off
+    assert resolve_step_spec(False) is None
+    assert resolve_step_spec(True) == modelstats.DEFAULT_DEPTH
+    assert resolve_step_spec(3) == 3
+    assert resolve_step_spec(ModelStats(depth=4)) == 4
+    modelstats.configure(True)
+    assert resolve_step_spec(None) == modelstats.DEFAULT_DEPTH
+    modelstats.get_model_stats().enabled = False
+    assert resolve_step_spec(None) is None
+    with pytest.raises(ValueError, match="model_stats"):
+        resolve_step_spec("bogus")
+
+
+def test_configure_forms_idempotency_and_shutdown(ms_off, monkeypatch):
+    assert modelstats.configure(False) is None
+    plane = modelstats.configure(True)
+    assert plane is not None and plane.depth == modelstats.DEFAULT_DEPTH
+    assert modelstats.configure(True) is plane  # idempotent replay
+    deep = modelstats.configure(3)
+    assert deep is not plane and deep.depth == 3
+    assert modelstats.configure("3") is deep
+    custom = ModelStats(depth=5, top_k=2)
+    assert modelstats.configure(custom) is custom
+    with pytest.raises(ValueError, match="model_stats"):
+        modelstats.configure("bogus")
+    # Env route + the warn-and-default knob parsing.
+    monkeypatch.setenv("FLUXMPI_TPU_MODEL_STATS", "0")
+    assert modelstats.configure() is None
+    monkeypatch.setenv("FLUXMPI_TPU_MODEL_STATS", "1")
+    monkeypatch.setenv("FLUXMPI_TPU_MODEL_STATS_DEPTH", "junk")
+    monkeypatch.setenv("FLUXMPI_TPU_MODEL_STATS_TOPK", "7")
+    with pytest.warns(UserWarning, match="FLUXMPI_TPU_MODEL_STATS_DEPTH"):
+        env_plane = modelstats.configure()
+    assert env_plane.depth == modelstats.DEFAULT_DEPTH
+    assert env_plane.top_k == 7
+    telemetry.shutdown()
+    assert modelstats.get_model_stats() is None
+
+
+def test_model_namespace_is_closed():
+    rec = {
+        "schema": tschema.SCHEMA,
+        "time_unix": 1.0,
+        "process": 0,
+        "metrics": [
+            {
+                "name": "model.not_a_thing",
+                "type": "gauge",
+                "labels": {},
+                "value": 1.0,
+            }
+        ],
+    }
+    errs = tschema.validate_record(rec)
+    assert any("model.not_a_thing" in e for e in errs)
+
+
+# ---------------------------------------------------------------------------
+# In-jit collection: oracle checks, sharded trees, trajectory invariance
+# ---------------------------------------------------------------------------
+
+
+def test_step_stats_match_numpy_oracle(world, ms_off):
+    """A direct (wrapper-driven) instrumented step with model_stats=True
+    emits per-layer gauges matching grads/updates recomputed outside."""
+    modelstats.configure(True)
+    loss_fn, opt, params, ds = _mlp_pieces()
+    reg = MetricsRegistry()
+    step = make_train_step(
+        loss_fn, opt, mesh=world, metrics=reg, model_stats=True, donate=False
+    )
+    state = replicate(TrainState.create(params, opt, None), world)
+    x, y = ds.arrays
+    batch = (x[:64], y[:64])
+    from fluxmpi_tpu.parallel.train import shard_batch
+
+    step(state, shard_batch(batch, world))
+    (_, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        params, None, batch
+    )
+    updates, _ = opt.update(grads, opt.init(params), params)
+    for group, slot in (
+        ("params/dense_0", "dense_0"),
+        ("params/dense_1", "dense_1"),
+        ("params/dense_2", "dense_2"),
+    ):
+        g_leaves = jax.tree_util.tree_leaves(grads["params"][slot])
+        oracle_g = math.sqrt(
+            sum(float(np.sum(np.square(np.asarray(g)))) for g in g_leaves)
+        )
+        p_leaves = jax.tree_util.tree_leaves(params["params"][slot])
+        oracle_p = math.sqrt(
+            sum(float(np.sum(np.square(np.asarray(p)))) for p in p_leaves)
+        )
+        u_leaves = jax.tree_util.tree_leaves(updates["params"][slot])
+        oracle_u = math.sqrt(
+            sum(float(np.sum(np.square(np.asarray(u)))) for u in u_leaves)
+        )
+        assert reg.gauge(
+            "model.layer_grad_norm", layer=group
+        ).value == pytest.approx(oracle_g, rel=1e-5)
+        assert reg.gauge(
+            "model.layer_param_norm", layer=group
+        ).value == pytest.approx(oracle_p, rel=1e-5)
+        assert reg.gauge(
+            "model.update_ratio", layer=group
+        ).value == pytest.approx(oracle_u / oracle_p, rel=1e-5)
+        assert reg.gauge("model.nonfinite", layer=group).value == 0.0
+
+
+def test_sharded_param_tree_stats_match_replicated_oracle(world, ms_off):
+    """Satellite: under an FSDP-style layout the per-layer norms must be
+    GLOBAL values (XLA reduces across shards inside the program), equal
+    to the replicated run's — asserted against a replicated oracle."""
+    from fluxmpi_tpu.parallel import fsdp_rule, shard_tree
+    from fluxmpi_tpu.parallel.train import shard_batch
+
+    modelstats.configure(True)
+    model = MLP(features=(16, 16, 1))
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 2)))
+    opt = optax.adam(0.05)
+
+    def loss_fn(p, mstate, batch):
+        x, y = batch
+        return jnp.mean((model.apply(p, x) - y) ** 2), mstate
+
+    state = TrainState.create(params, opt)
+    rule = fsdp_rule(world, min_size=16)
+    sharded_state, shardings = shard_tree(state, world, rule)
+    reg = MetricsRegistry()
+    step = make_train_step(
+        loss_fn, opt, mesh=world, state_sharding=shardings,
+        metrics=reg, model_stats=True, donate=False,
+    )
+    rng = np.random.default_rng(1)
+    batch = (
+        rng.normal(size=(16, 2)).astype(np.float32),
+        rng.normal(size=(16, 1)).astype(np.float32),
+    )
+    step(sharded_state, shard_batch(batch, world))
+    # Replicated oracle: full-value grads of the same batch.
+    (_, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        params, None, batch
+    )
+    for slot in ("dense_0", "dense_1", "dense_2"):
+        leaves = jax.tree_util.tree_leaves(
+            jax.device_get(grads["params"][slot])
+        )
+        oracle = math.sqrt(
+            sum(float(np.sum(np.square(np.asarray(g)))) for g in leaves)
+        )
+        got = reg.gauge(
+            "model.layer_grad_norm", layer=f"params/{slot}"
+        ).value
+        assert got == pytest.approx(oracle, rel=1e-4), slot
+
+
+def _run_loop(world, *, stats, fuse, metrics=True, scan_steps=1,
+              record_flushes=None):
+    loss_fn, opt, params, ds = _mlp_pieces()
+    if stats:
+        plane = modelstats.configure(True)
+        if record_flushes is not None:
+            orig = ModelStats.observe_flush
+
+            def recording(self, tree, **kw):
+                out = orig(self, tree, **kw)
+                record_flushes.append(out)
+                return out
+
+            plane.observe_flush = recording.__get__(plane)
+    else:
+        modelstats.set_model_stats(None)
+    step = make_train_step(
+        loss_fn, opt, mesh=world, metrics=metrics, scan_steps=scan_steps
+    )
+    state = replicate(TrainState.create(params, opt, None), world)
+    loader = DistributedDataLoader(ds, 64, mesh=world)
+    final, summary = train_loop(
+        step, state, loader, epochs=2, flush_every=2, fuse=fuse
+    )
+    return jax.device_get(final), summary
+
+
+def test_trajectory_invariance_both_drivers(world, ms_off):
+    """Acceptance: model_stats on is bit-identical (assert_array_equal)
+    to off, on the pipelined AND the fused-window path — the stats tree
+    reads the values the program already computes, never changes them."""
+    on_pipe, s1 = _run_loop(world, stats=True, fuse=False)
+    off_pipe, _ = _run_loop(world, stats=False, fuse=False)
+    on_fused, s3 = _run_loop(world, stats=True, fuse="window")
+    off_fused, _ = _run_loop(world, stats=False, fuse="window")
+    assert s1["updates"] == s3["updates"] == 8
+    assert s3["fused_window"] == 2
+    for a, b in ((on_pipe, off_pipe), (on_fused, off_fused),
+                 (on_pipe, on_fused)):
+        jax.tree_util.tree_map(
+            np.testing.assert_array_equal, a.params, b.params
+        )
+        jax.tree_util.tree_map(
+            np.testing.assert_array_equal, a.opt_state, b.opt_state
+        )
+
+
+def test_pipelined_and_fused_emit_equal_stats(world, ms_off):
+    """Acceptance: both drivers emit IDENTICAL per-flush stats for the
+    same run (the fused window folds the tree into its scan carry; the
+    pipelined path reads the last dispatch's — same update, same
+    numbers)."""
+    pipe_flushes: list = []
+    fused_flushes: list = []
+    _run_loop(world, stats=True, fuse=False, record_flushes=pipe_flushes)
+    _run_loop(world, stats=True, fuse="window", record_flushes=fused_flushes)
+    assert len(pipe_flushes) == len(fused_flushes) == 4
+    for a, b in zip(pipe_flushes, fused_flushes):
+        assert a["layers"] == b["layers"]
+        assert a["param_norms"] == b["param_norms"]
+        assert a["update_ratios"] == b["update_ratios"]
+        assert a["nonfinite_layer"] is None and b["nonfinite_layer"] is None
+
+
+def test_scan_steps_stats_describe_last_update(world, ms_off):
+    """A scan_steps step stacks per-update stats [K]; the flush (and the
+    per-step wrapper) must report the NEWEST update's tree — matching a
+    k=1 run at the same update count."""
+    flushes_k2: list = []
+    flushes_k1: list = []
+    _run_loop(world, stats=True, fuse=False, scan_steps=2,
+              record_flushes=flushes_k2)
+    _run_loop(world, stats=True, fuse=False, scan_steps=1,
+              record_flushes=flushes_k1)
+    assert flushes_k2  # flush_every=2 == one scan dispatch per flush
+    assert flushes_k2[0]["layers"] == flushes_k1[0]["layers"]
+
+
+# ---------------------------------------------------------------------------
+# Gradient noise scale (shard_map) end to end
+# ---------------------------------------------------------------------------
+
+
+def test_shard_map_noise_scale_end_to_end(world, ms_off):
+    modelstats.configure(True)
+    loss_fn, opt, params, ds = _mlp_pieces()
+    reg = MetricsRegistry()
+    step = make_train_step(
+        loss_fn, opt, mesh=world, style="shard_map", metrics=reg,
+        model_stats=True,
+    )
+    state = replicate(TrainState.create(params, opt, None), world)
+    loader = DistributedDataLoader(ds, 64, mesh=world)
+    train_loop(step, state, loader, epochs=1, flush_every=2, fuse=False)
+    local = reg.gauge("model.grad_sqnorm_local").value
+    glob = reg.gauge("model.grad_sqnorm_global").value
+    ns = reg.gauge("model.grad_noise_scale").value
+    # E over ranks of |g_rank|^2 >= |mean g|^2 always (Jensen); real
+    # per-example noise makes it strictly larger, so B_simple > 0.
+    assert local >= glob > 0.0
+    assert ns > 0.0 and math.isfinite(ns)
+    assert ns == pytest.approx(
+        noise_scale(local, glob, batch_examples=64, workers=8)
+    )
+
+
+def test_shard_map_noise_scale_sum_reduce_matches_mean(world, ms_off):
+    """grad_reduce='sum' consumes W x the mean gradient; the noise
+    ingredients must rescale to the AVERAGE convention, so the recorded
+    sq-norms match a grad_reduce='mean' step's."""
+    modelstats.configure(True)
+    vals = {}
+    for reduce in ("mean", "sum"):
+        loss_fn, opt, params, ds = _mlp_pieces()
+        reg = MetricsRegistry()
+        step = make_train_step(
+            loss_fn, opt, mesh=world, style="shard_map",
+            grad_reduce=reduce, metrics=reg, model_stats=True,
+        )
+        state = replicate(TrainState.create(params, opt, None), world)
+        loader = DistributedDataLoader(ds, 64, mesh=world)
+        train_loop(step, state, loader, steps=1, flush_every=1, fuse=False)
+        vals[reduce] = (
+            reg.gauge("model.grad_sqnorm_local").value,
+            reg.gauge("model.grad_sqnorm_global").value,
+        )
+    assert vals["sum"][0] == pytest.approx(vals["mean"][0], rel=1e-5)
+    assert vals["sum"][1] == pytest.approx(vals["mean"][1], rel=1e-5)
+
+
+def test_auto_style_carries_no_noise_ingredients(world, ms_off):
+    """style='auto' never materializes a per-rank gradient — the noise
+    gauges must be absent, not zero-filled garbage."""
+    modelstats.configure(True)
+    loss_fn, opt, params, ds = _mlp_pieces()
+    reg = MetricsRegistry()
+    step = make_train_step(
+        loss_fn, opt, mesh=world, metrics=reg, model_stats=True
+    )
+    state = replicate(TrainState.create(params, opt, None), world)
+    loader = DistributedDataLoader(ds, 64, mesh=world)
+    train_loop(step, state, loader, steps=2, flush_every=2, fuse=False)
+    names = {m["name"] for m in reg.snapshot()}
+    assert "model.layer_grad_norm" in names
+    assert "model.grad_sqnorm_local" not in names
+    assert "model.grad_noise_scale" not in names
+
+
+# ---------------------------------------------------------------------------
+# Anomaly layer rules + NaN provenance
+# ---------------------------------------------------------------------------
+
+
+def test_layer_grad_explosion_rule(ms_off):
+    det = AnomalyDetector(warmup=2, layer_explosion_factor=5.0, dump=False)
+    base = {"params/a": 1.0, "params/b": 1.0}
+    for step in range(3):
+        assert det.observe(layer_grad_norms=base, step=step) == []
+    events = det.observe(
+        layer_grad_norms={"params/a": 50.0, "params/b": 1.0}, step=3
+    )
+    assert len(events) == 1
+    ev = events[0]
+    assert ev["rule"] == "layer_grad_explosion"
+    assert ev["layer"] == "params/a"
+    assert ev["action"] == "warn"  # statistical-rule default policy
+    assert ev["value"] == pytest.approx(50.0)
+    # The instant carries the layer (fluxmpi_top renders it).
+    assert det.triggered[-1]["layer"] == "params/a"
+
+
+def test_dead_layer_rule_fires_once_and_rearms(ms_off):
+    det = AnomalyDetector(
+        warmup=1, dead_layer_flushes=3, dump=False
+    )
+    live = {"params/a": 1.0, "params/b": 0.0}
+    fired = []
+    for step in range(7):
+        fired.extend(det.observe(layer_grad_norms=live, step=step))
+    # Streak hits 3 at the third flush; staying dead does NOT re-fire.
+    assert [e["rule"] for e in fired] == ["dead_layer"]
+    assert fired[0]["layer"] == "params/b"
+    # Recovery re-arms: one live flush, then three dead ones fire again.
+    det.observe(layer_grad_norms={"params/a": 1.0, "params/b": 1.0}, step=7)
+    again = []
+    for step in range(8, 11):
+        again.extend(det.observe(layer_grad_norms=live, step=step))
+    assert [e["rule"] for e in again] == ["dead_layer"]
+
+
+def test_nan_provenance_end_to_end(world, tmp_path, ms_off):
+    """Acceptance: an injected PER-LAYER NaN (loss finite, one layer's
+    gradient NaN) halts via nan_grad with the offending layer named in
+    the anomaly event, the trace instant, and the diagnostics bundle —
+    all schema-valid via check_metrics_schema.py."""
+    from fluxmpi_tpu.telemetry import tracing
+
+    jsonl = str(tmp_path / "run.jsonl")
+    reg = MetricsRegistry(sinks=[JSONLSink(jsonl)])
+    modelstats.configure(True)
+    anomaly.set_anomaly_detector(
+        AnomalyDetector(dump_dir=str(tmp_path), registry=reg)
+    )
+    tracer = tracing.Tracer(enabled=True)
+    prev_tracer = tracing.set_tracer(tracer)
+    # Batch 4 (samples 192..255) carries the sentinel that poisons
+    # ONLY dense_1's kernel gradient.
+    loss_fn, opt, params, ds = _mlp_pieces(
+        poison_layer="dense_1", poison_from=192
+    )
+    loader = DistributedDataLoader(ds, 64, mesh=world)
+    step = make_train_step(
+        loss_fn, opt, mesh=world, metrics=reg, model_stats=True
+    )
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            state, summary = train_loop(
+                step, replicate(TrainState.create(params, opt, None), world),
+                loader, epochs=2, flush_every=2, fuse=False,
+            )
+    finally:
+        tracing.set_tracer(prev_tracer)
+    assert summary["anomaly"] == "nan_grad"
+    assert summary["updates"] == 4  # halted at the flush that saw it
+    det = anomaly.get_anomaly_detector()
+    ev = next(e for e in det.triggered if e["rule"] == "nan_grad")
+    assert ev["layer"] == "params/dense_1"
+    # Per-layer nonfinite gauge names the layer in the metrics plane.
+    assert reg.gauge(
+        "model.nonfinite", layer="params/dense_1"
+    ).value > 0.0
+    assert reg.gauge("model.nonfinite", layer="params/dense_0").value == 0.0
+    # Trace instant carries the layer, schema-valid.
+    trace = tracer.export()
+    assert tschema.validate_trace_export(trace) == []
+    instants = [
+        e for e in trace["traceEvents"] if e.get("name") == "anomaly.nan_grad"
+    ]
+    assert len(instants) == 1
+    assert instants[0]["args"]["layer"] == "params/dense_1"
+    assert instants[0]["args"]["step"] == 4
+    # Bundle on disk, schema-valid, layer inside.
+    bundle = json.loads((tmp_path / "fluxmpi_anomaly.0.json").read_text())
+    assert tschema.validate_watchdog_dump(bundle) == []
+    assert bundle["anomaly"]["layer"] == "params/dense_1"
+    # The JSONL stream (model.* included) passes the checker CLI.
+    reg.close()
+    proc = subprocess.run(
+        [sys.executable, _CHECKER, jsonl], capture_output=True, text=True
+    )
+    assert proc.returncode == 0, proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# Zero-cost-when-off
+# ---------------------------------------------------------------------------
+
+
+def test_train_loop_fully_off_computes_no_stats(world, ms_off, monkeypatch):
+    """The monkeypatch-explode contract: plane off means NO stats
+    computation at build time, no grouping, no observe_flush, on both
+    the build and the drive path."""
+    assert modelstats.get_model_stats() is None
+
+    def boom(*a, **k):
+        raise AssertionError("model-stats plane touched on the off path")
+
+    monkeypatch.setattr(modelstats, "compute_stats", boom)
+    monkeypatch.setattr(modelstats, "stats_zeros", boom)
+    monkeypatch.setattr(modelstats, "group_paths", boom)
+    monkeypatch.setattr(ModelStats, "observe_flush", boom)
+    loss_fn, opt, params, ds = _mlp_pieces()
+    loader = DistributedDataLoader(ds, 64, mesh=world)
+    step = make_train_step(loss_fn, opt, mesh=world)
+    state = replicate(TrainState.create(params, opt, None), world)
+    _, summary = train_loop(step, state, loader, epochs=1, flush_every=2)
+    assert summary["updates"] == 4
+
+
+def test_plane_on_but_statless_step_emits_nothing(world, ms_off):
+    """A step compiled while the plane was OFF keeps running after it
+    turns on — stats-less (collection is baked at build time), with the
+    flush never attempting an observe."""
+    loss_fn, opt, params, ds = _mlp_pieces()
+    step = make_train_step(loss_fn, opt, mesh=world, metrics=True)
+    modelstats.configure(True)  # turned on AFTER the build
+    reg = get_registry()
+    reg.reset()
+    loader = DistributedDataLoader(ds, 64, mesh=world)
+    state = replicate(TrainState.create(params, opt, None), world)
+    _, summary = train_loop(step, state, loader, epochs=1, flush_every=2)
+    assert summary["updates"] == 4
+    assert not any(
+        m["name"].startswith("model.") for m in reg.snapshot()
+    )
+
+
+# ---------------------------------------------------------------------------
+# init() wiring, /status board, fluxmpi_top, report CLI
+# ---------------------------------------------------------------------------
+
+
+def test_init_model_stats_round_trip(world, ms_off):
+    import fluxmpi_tpu as fm
+
+    fm.init(model_stats=True)  # idempotent replay applies the spec
+    assert modelstats.get_model_stats() is not None
+    fm.init(model_stats=False)
+    assert modelstats.get_model_stats() is None
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=5
+    ) as resp:
+        return resp.status, resp.read()
+
+
+def test_status_model_board_and_fluxmpi_top(world, ms_off):
+    from fluxmpi_tpu.telemetry.export import Exporter
+    from fluxmpi_tpu.telemetry.schema import validate_status_record
+
+    get_registry().reset()
+    modelstats.configure(True)
+    exp = Exporter(0, "127.0.0.1", deadline=3600.0)
+    export.configure(exp)
+    try:
+        loss_fn, opt, params, ds = _mlp_pieces()
+        loader = DistributedDataLoader(ds, 64, mesh=world)
+        step = make_train_step(
+            loss_fn, opt, mesh=world, metrics=True, model_stats=True
+        )
+        state = replicate(TrainState.create(params, opt, None), world)
+        train_loop(step, state, loader, epochs=1, flush_every=2, fuse=False)
+        code, body = _get(exp.port, "/status")
+        assert code == 200
+        status = json.loads(body)
+        assert validate_status_record(status) == []
+        board = status["model"]
+        assert board is not None
+        assert board["nonfinite_layer"] is None
+        top_layers = [t["layer"] for t in board["top"]]
+        assert "params/dense_0" in top_layers or "params/dense_1" in (
+            top_layers
+        )
+        # fluxmpi_top renders the MODEL block from the same snapshot.
+        proc = subprocess.run(
+            [sys.executable, _TOP, f"127.0.0.1:{exp.port}", "--once"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "MODEL" in proc.stdout
+        assert "params/dense_" in proc.stdout
+    finally:
+        export.shutdown()
+
+
+def test_fluxmpi_top_anomaly_ticker_renders_labels():
+    """Satellite: the ticker names the triggering event's layer /
+    function instead of the bare rule id (render_frame unit — the
+    script is imported by file path, the goodput_report test trick)."""
+    spec = importlib.util.spec_from_file_location("_fm_top", _TOP)
+    top = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(top)
+    statuses = {
+        "host-a": {
+            "run_id": "r1",
+            "train": {"updates": 10, "phase": "running"},
+            "anomaly": {
+                "rule": "steady_state_retrace",
+                "function": "train_loop.step",
+                "value_repr": "3",
+                "step": 10,
+            },
+        },
+        "host-b": {
+            "run_id": "r1",
+            "train": {"updates": 10},
+            "anomaly": {
+                "rule": "nan_grad",
+                "layer": "params/dense_1",
+                "value_repr": "nan",
+                "step": 10,
+            },
+            "model": {
+                "noise_scale": 123.4,
+                "nonfinite_layer": "params/dense_1",
+                "top": [{"layer": "params/dense_1", "grad_norm": 3.2}],
+                "step": 10,
+            },
+        },
+    }
+    frame = top.render_frame(statuses, {})
+    assert "function=train_loop.step" in frame
+    assert "layer=params/dense_1" in frame
+    assert "MODEL" in frame
+    assert "123" in frame  # noise-scale readout
+    assert "NONFINITE gradients in params/dense_1" in frame
+
+
+def test_modelstats_report_cli(world, tmp_path, ms_off):
+    jsonl = str(tmp_path / "run.jsonl")
+    reg = MetricsRegistry(sinks=[JSONLSink(jsonl)])
+    modelstats.configure(True)
+    loss_fn, opt, params, ds = _mlp_pieces()
+    loader = DistributedDataLoader(ds, 64, mesh=world)
+    step = make_train_step(
+        loss_fn, opt, mesh=world, style="shard_map", metrics=reg,
+        model_stats=True,
+    )
+    state = replicate(TrainState.create(params, opt, None), world)
+    train_loop(step, state, loader, epochs=1, flush_every=2, fuse=False)
+    reg.close()
+    proc = subprocess.run(
+        [sys.executable, _REPORT, jsonl, "--history",
+         "--batch", "64", "--workers", "8"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "params/dense_1" in proc.stdout
+    assert "noise scale" in proc.stdout
+    # History mode aggregates the INGREDIENT means (unbiased — present
+    # even on flushes whose derived estimate was censored) and, with
+    # the run geometry given, derives B_simple from them.
+    assert "ingredient means" in proc.stdout
+    assert "B_simple from ingredient means" in proc.stdout
+    # --json round-trips.
+    proc = subprocess.run(
+        [sys.executable, _REPORT, jsonl, "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    data = json.loads(proc.stdout)
+    assert "params/dense_1" in data["hosts"]["0"]["layers"]
+    assert data["hosts"]["0"]["scalars"]["grad_noise_scale"] > 0
+    # A bank without model metrics exits 1 (plane was off).
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text(
+        json.dumps(
+            {
+                "schema": tschema.SCHEMA,
+                "time_unix": 1.0,
+                "process": 0,
+                "metrics": [],
+            }
+        )
+        + "\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, _REPORT, str(empty)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 1
+    # A missing file exits 2.
+    proc = subprocess.run(
+        [sys.executable, _REPORT, str(tmp_path / "nope.jsonl")],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 2

@@ -34,6 +34,7 @@ from fluxmpi_tpu.telemetry import (
     validate_bench_record,
     validate_record,
 )
+from fluxmpi_tpu.telemetry import schema
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _CHECKER = os.path.join(_REPO, "scripts", "check_metrics_schema.py")
@@ -66,6 +67,46 @@ def test_counter_gauge_histogram_semantics():
     assert h.sum == pytest.approx(3.0)
     assert h.min == 0.5 and h.max == 1.5 and h.last == 1.0
     assert h.mean == pytest.approx(1.0)
+    # No schema-declared edges for this name: bucket-free summary.
+    assert h.bins is None
+    assert "buckets" not in h.snapshot()
+
+
+def test_histogram_schema_declared_buckets():
+    """Names with edges in schema.HISTOGRAM_BUCKET_EDGES bin into
+    cumulative Prometheus-shaped buckets; the snapshot validates and an
+    over-the-top observation counts only toward the implicit +Inf."""
+    from fluxmpi_tpu.telemetry.schema import HISTOGRAM_BUCKET_EDGES
+
+    reg = MetricsRegistry()
+    h = reg.histogram("train.step_seconds")
+    edges = HISTOGRAM_BUCKET_EDGES["train.step_seconds"]
+    assert tuple(h.edges) == edges
+    h.observe(0.003)   # lands in the le=0.005 bin
+    h.observe(0.003)
+    h.observe(0.3)     # le=0.5
+    h.observe(1e9)     # beyond the last edge: +Inf only
+    snap = h.snapshot()
+    buckets = snap["buckets"]
+    assert buckets["edges"] == list(edges)
+    cum = dict(zip(buckets["edges"], buckets["counts"]))
+    assert cum[0.0025] == 0
+    assert cum[0.005] == 2
+    assert cum[0.25] == 2
+    assert cum[0.5] == 3
+    assert cum[edges[-1]] == 3  # the 1e9 sample is only in count (+Inf)
+    assert snap["count"] == 4
+    # Cumulative counts are non-decreasing and the metric validates.
+    assert buckets["counts"] == sorted(buckets["counts"])
+    assert schema.validate_metric(snap) == []
+    # A flush record carrying buckets stays schema-clean end to end.
+    assert schema.validate_record(reg.flush()) == []
+    # Corrupt bucket shapes are rejected.
+    bad = dict(snap)
+    bad["buckets"] = {"edges": [2.0, 1.0], "counts": [1, 0]}
+    errs = schema.validate_metric(bad)
+    assert any("strictly increasing" in e for e in errs)
+    assert any("cumulative" in e for e in errs)
 
 
 def test_labels_key_identity_and_separation():
