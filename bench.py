@@ -35,8 +35,13 @@ machine is never presented as a regression ratio.
 Env knobs:
   FLUXMPI_TPU_BENCH_CONFIG    force one config
                               (resnet50|cnn|mlp|attention|transformer|deq|
-                              unet|serving — unet and serving are
-                              forced-only, not in the fallback plan)
+                              unet|serving|train_loop — unet, serving and
+                              train_loop are forced-only, not in the
+                              fallback plan; train_loop is what the
+                              scaling and per-axis legs spawn)
+  FLUXMPI_TPU_BENCH_PARALLEL  ParallelConfig for the train_loop child,
+                              e.g. "dp=4,fsdp=2" (default dp=-1: all
+                              visible devices data-parallel)
   FLUXMPI_TPU_BENCH_TIMEOUT   override per-config child timeout in seconds
   FLUXMPI_TPU_BENCH_BUDGET    overall wall budget in seconds (default 4200;
                               sized so the 1800 s lease-TTL probe attempt
@@ -759,6 +764,168 @@ def _regression_workload(model, per_chip_batch: int, n_dev: int):
     return model, x, y, loss_fn, optax.adam(1e-3)
 
 
+def _parse_parallel_env() -> dict[str, int]:
+    """FLUXMPI_TPU_BENCH_PARALLEL ("dp=4,fsdp=2") → ParallelConfig
+    kwargs. Default: everything data-parallel (dp=-1, inferred). A
+    malformed value warns and takes the default (the repo's env-typo
+    convention: a typo degrades the leg, never crashes the child)."""
+    spec = os.environ.get("FLUXMPI_TPU_BENCH_PARALLEL", "").strip()
+    if not spec:
+        return {"dp": -1}
+    kwargs: dict[str, int] = {}
+    try:
+        for part in spec.split(","):
+            axis, sep, size = part.partition("=")
+            if not sep:
+                raise ValueError(f"missing '=' in {part!r}")
+            kwargs[axis.strip()] = int(size)
+        # ParallelConfig is the single source of truth for axis names,
+        # size bounds, and the one--1 rule: a spec it would reject in
+        # the child degrades here instead, per the warn-and-default
+        # contract. Keys are restricted to the plan AXES first —
+        # non-axis constructor kwargs (fsdp_min_size=, strict=) are not
+        # for this env var and would collide with _bench_train_loop's
+        # own arguments.
+        from fluxmpi_tpu.parallel.plan import _PLAN_AXES, ParallelConfig
+
+        unknown = set(kwargs) - set(_PLAN_AXES)
+        if unknown:
+            raise ValueError(
+                f"unknown axis {sorted(unknown)} (know {_PLAN_AXES})"
+            )
+        ParallelConfig(**kwargs)
+    except (TypeError, ValueError) as exc:
+        print(
+            f"bench: ignoring FLUXMPI_TPU_BENCH_PARALLEL={spec!r} "
+            f"({exc}); using dp=-1",
+            file=sys.stderr,
+        )
+        return {"dp": -1}
+    return kwargs
+
+
+def _bench_train_loop():
+    """Scaling-leg workload ON the real hot path: a small TransformerLM
+    trained by ``train_loop(fuse="window")`` — one-program flush windows,
+    device-gather loader, donated carries — under the ``ParallelConfig``
+    named by ``FLUXMPI_TPU_BENCH_PARALLEL`` (default ``dp=-1``: all
+    visible devices data-parallel). This is what the dp-scaling legs and
+    the per-axis composition legs run (the pre-plan scaling legs timed a
+    synthetic step; the number here is the driver users actually get).
+    The record banks tokens/sec/chip plus a ``parallel`` block with the
+    resolved axes, the plan's rule-hit counts, and the loop's own
+    ``dispatches_per_update`` — the fused-path assertion
+    (``1/window``) made under the plan-derived sharding."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import fluxmpi_tpu as fm
+    from fluxmpi_tpu import ParallelConfig
+    from fluxmpi_tpu.data import ArrayDataset, DistributedDataLoader
+    from fluxmpi_tpu.models import TransformerLM
+    from fluxmpi_tpu.parallel import TrainState, make_train_step, train_loop
+    from fluxmpi_tpu.parallel.train import replicate
+
+    devs = _visible_devices()
+    plan = ParallelConfig(**_parse_parallel_env(), fsdp_min_size=256).resolve(
+        devs
+    )
+    mesh = fm.init(devices=devs, parallel=plan)
+    n_dev = fm.total_workers()
+    device_kind = devs[0].device_kind
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        vocab, seq = 8192, 256
+        dims = dict(num_layers=4, d_model=512, num_heads=8, d_ff=2048)
+        per_shard = 8
+    else:
+        vocab, seq = 256, 64
+        dims = dict(num_layers=2, d_model=64, num_heads=4, d_ff=128)
+        per_shard = 8
+    window = 8
+    gbs = per_shard * plan.data_parallel_size
+    model = TransformerLM(vocab_size=vocab, max_len=seq, **dims)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, vocab, size=(gbs * window, seq)).astype(np.int32)
+    targets = rng.integers(0, vocab, size=(gbs * window, seq)).astype(np.int32)
+    dataset = ArrayDataset((tokens, targets))
+    optimizer = optax.adamw(1e-4)
+
+    def loss_fn(p, mstate, batch):
+        bx, by = batch
+        logits = model.apply(p, bx, train=False)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), by
+        ).mean()
+        return loss, mstate
+
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32), train=False
+    )
+    host_params = jax.device_get(params)
+
+    def fresh_state():
+        # The loop donates the state carry: every run needs its own.
+        state = TrainState.create(host_params, optimizer)
+        if plan.shards_parameters:
+            state, _ = plan.shard_state(state)
+        else:
+            state = replicate(state, mesh)
+        return state
+
+    # The first state both places the layout and BANKS it on the plan —
+    # the step factory then pins the same sharding the state carries.
+    state0 = fresh_state()
+    step = make_train_step(loss_fn, optimizer, parallel=plan)
+    loader = DistributedDataLoader(dataset, gbs, mesh=mesh)
+
+    def run(epochs, state):
+        _, summary = train_loop(
+            step, state, loader, epochs=epochs, fuse="window",
+            flush_every=window, metrics=False,
+        )
+        return summary
+
+    run(1, state0)  # warmup: jit + the window's AOT compile (cached)
+    epochs = max(2, int(os.environ.get("FLUXMPI_TPU_BENCH_STEPS", "24")) //
+                 window)
+    summary = run(epochs, fresh_state())
+    value = round(summary["examples_per_sec"] * seq / n_dev, 1)
+    sharded = 0
+    if plan.state_sharding is not None:
+        sharded = sum(
+            1
+            for sh in jax.tree_util.tree_leaves(plan.state_sharding.params)
+            if hasattr(sh, "spec")
+            and any(x is not None for x in tuple(sh.spec))
+        )
+    metric = "train_loop_tokens_per_sec_per_chip"
+    anchor = _anchor_for(metric)
+    desc = plan.describe()
+    return {
+        "metric": metric,
+        "value": value,
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(value / anchor, 4) if anchor else 1.0,
+        "platform": jax.default_backend(),
+        "device_kind": device_kind,
+        "n_chips": n_dev,
+        "parallel": {
+            "axes": desc["axes"],
+            "data_parallel_size": desc["data_parallel_size"],
+            "rule_hits": desc["rule_hits"],
+            "sharded_param_leaves": sharded,
+            "fused_window": summary["fused_window"],
+            "dispatches_per_update": round(
+                summary["dispatches"] / summary["updates"], 4
+            ),
+            "updates": summary["updates"],
+        },
+    }
+
+
 def _bench_deq():
     """Deep Equilibrium model (BASELINE config 4): implicit fixed-point
     forward + custom-VJP implicit backward, per-chip samples/sec."""
@@ -1168,6 +1335,7 @@ _CHILD_FNS = {
     "deq": _bench_deq,
     "unet": _bench_unet,
     "serving": _bench_serving,
+    "train_loop": _bench_train_loop,
 }
 
 
@@ -1367,27 +1535,28 @@ def _run_scaling(
     else:
         platform, n = "cpu", 8
         backend = "cpu"
-        # Append (not clobber) — the operator's own XLA_FLAGS survive; for
-        # duplicated flags the last occurrence wins in XLA's parser.
-        flags = (
-            os.environ.get("XLA_FLAGS", "")
-            + " --xla_force_host_platform_device_count=8"
-        ).strip()
-        extra = {"XLA_FLAGS": flags}
+        extra = _cpu_virtual_env()
         mode = "cpu-virtual"
     # Workload: the BASELINE scaling target is ResNet-50 DP ≥70% on a pod
-    # slice, so that is the default on real multi-chip TPU; the quick mlp
-    # child remains the cpu-virtual plumbing proof (and an override for
-    # short-budget slice runs). See docs/performance.md "Pod-slice
-    # scaling runbook".
+    # slice, so that is the default on real multi-chip TPU; elsewhere the
+    # legs run the train_loop child — the REAL fused hot path
+    # (train_loop(fuse="window") under a plan-derived sharding), retiring
+    # the synthetic-step scaling measurement. See docs/performance.md
+    # "Pod-slice scaling runbook" / "Choosing a layout".
     cfg = os.environ.get("FLUXMPI_TPU_BENCH_SCALING_CONFIG") or (
-        "resnet50" if backend == "tpu" else "mlp"
+        "resnet50" if backend == "tpu" else "train_loop"
     )
     cap = 600.0 if cfg == "resnet50" else 240.0
     per_child = min(cap, (remaining_s - 10) / 2)
     if per_child < 45:
         return None
-    extra = {**extra, "FLUXMPI_TPU_BENCH_MLP_BATCH": "512"}
+    # Pin the plan spec per leg (dp=-1: all the leg's devices) — an
+    # operator-set FLUXMPI_TPU_BENCH_PARALLEL is for the forced
+    # train_loop child and must not leak into the dp1 leg (dp=4 on one
+    # device is a TopologyMismatchError that would silently drop the
+    # whole scaling block).
+    extra = {**extra, "FLUXMPI_TPU_BENCH_MLP_BATCH": "512",
+             "FLUXMPI_TPU_BENCH_PARALLEL": ""}
     r1 = _run_child(cfg, per_child, platform,
                     {**extra, "FLUXMPI_TPU_BENCH_DEVICES": "1"})
     rn = _run_child(cfg, per_child, platform,
@@ -1428,6 +1597,12 @@ def _leg_breakdown(rec: dict) -> dict:
         out["dispatch_us"] = dispatch.get("per_dispatch_us")
     if "scan_steps" in rec:
         out["scan_steps"] = rec["scan_steps"]
+    par = rec.get("parallel")
+    if isinstance(par, dict):
+        # train_loop-child legs: the real driver's own dispatch
+        # accounting under the plan-derived sharding.
+        out["dispatches_per_update"] = par.get("dispatches_per_update")
+        out["window"] = par.get("fused_window")
     fused = rec.get("fused_window")
     if isinstance(fused, dict):
         # The fused-vs-pipelined dispatch accounting per leg: how many
@@ -1443,6 +1618,67 @@ def _leg_breakdown(rec: dict) -> dict:
             "speedup": fused.get("speedup"),
         }
     return out
+
+
+def _cpu_virtual_env() -> dict[str, str]:
+    """Child env for the 8-virtual-device CPU mesh (append, not clobber
+    — the operator's own XLA_FLAGS survive; for duplicated flags the
+    last occurrence wins in XLA's parser)."""
+    flags = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    return {"XLA_FLAGS": flags}
+
+
+# The per-axis composition legs: same train_loop(fuse="window") workload,
+# same 8 devices, different ParallelConfig — what each axis costs/buys
+# relative to pure dp (docs/performance.md, "Choosing a layout").
+_AXIS_LEGS: tuple[tuple[str, str], ...] = (
+    ("dp", "dp=8"),
+    ("dp_fsdp", "dp=4,fsdp=2"),
+    ("dp_tp", "dp=4,tp=2"),
+)
+
+
+def _axis_leg_summary(rec: dict) -> dict:
+    par = rec.get("parallel") or {}
+    return {
+        "axes": par.get("axes"),
+        "per_chip": rec.get("value"),
+        "unit": rec.get("unit"),
+        "n_chips": rec.get("n_chips"),
+        "data_parallel_size": par.get("data_parallel_size"),
+        "dispatches_per_update": par.get("dispatches_per_update"),
+        "sharded_param_leaves": par.get("sharded_param_leaves"),
+        "rule_hits": par.get("rule_hits"),
+    }
+
+
+def _run_axis_bench(remaining_s: float) -> dict | None:
+    """Per-axis bench children on the CPU virtual mesh: dp-only vs
+    dp×fsdp vs dp×tp, every leg through the real
+    ``train_loop(fuse="window")`` driver under its ``ParallelConfig``.
+    Returns ``{leg: summary}`` for the legs that completed (None when
+    none did / no budget)."""
+    per_child = min(240.0, (remaining_s - 10) / len(_AXIS_LEGS))
+    if per_child < 45:
+        return None
+    out: dict[str, dict] = {}
+    for name, spec in _AXIS_LEGS:
+        # Pin DEVICES too: these legs need all 8 virtual devices — an
+        # operator-set submesh truncation (a TPU-run knob) would make
+        # every fixed-size plan a TopologyMismatchError.
+        rec = _run_child(
+            "train_loop",
+            per_child,
+            "cpu",
+            {**_cpu_virtual_env(), "FLUXMPI_TPU_BENCH_PARALLEL": spec,
+             "FLUXMPI_TPU_BENCH_DEVICES": ""},
+        )
+        if rec is not None:
+            out[name] = _axis_leg_summary(rec)
+    return out or None
 
 
 def _bench_result_key(bench: dict) -> tuple:
@@ -1565,7 +1801,10 @@ def _run_smoke(remaining) -> None:
     # entry point: FLUXMPI_TPU_BENCH_SMOKE=1 + _CONFIG=serving); the
     # scaling pair only applies to the default mlp smoke.
     config = os.environ.get("FLUXMPI_TPU_BENCH_CONFIG") or "mlp"
-    result = _run_child(config, 240.0, "cpu")
+    # The train_loop child composes axes over the 8-virtual-device mesh;
+    # a bare smoke host may expose only one CPU device.
+    extra = _cpu_virtual_env() if config == "train_loop" else None
+    result = _run_child(config, 240.0, "cpu", extra)
     if result is None:
         result = {"metric": "bench_failed", "value": 0.0, "unit": "none",
                   "vs_baseline": 0.0, "config": config, "platform": "cpu"}
@@ -1578,6 +1817,24 @@ def _run_smoke(remaining) -> None:
         scaling = _run_scaling(min(remaining(), 340.0), None, None)
         if scaling is not None:
             result["scaling"] = scaling
+        # Fast dp×fsdp composition leg: the plan-derived sharding on the
+        # real fused driver, smoke-sized (skippable via the same
+        # FLUXMPI_TPU_BENCH_SMOKE_SCALING=0 knob as the pair above).
+        leg_budget = min(remaining() - 10, 180.0)
+        leg = (
+            _run_child(
+                "train_loop",
+                leg_budget,
+                "cpu",
+                {**_cpu_virtual_env(),
+                 "FLUXMPI_TPU_BENCH_PARALLEL": "dp=4,fsdp=2",
+                 "FLUXMPI_TPU_BENCH_DEVICES": ""},
+            )
+            if leg_budget >= 45
+            else None
+        )
+        if leg is not None:
+            result["parallel_axes"] = {"dp_fsdp": _axis_leg_summary(leg)}
     _emit_telemetry(result)
     print(json.dumps(result))
 
@@ -1609,9 +1866,18 @@ def main() -> None:
         # unet is forced-only (not in the fallback plan) but is as
         # compile-heavy as resnet50 on a cold cache: same 900 s.
         child_to = float(timeout_override) if timeout_override else {
-            **dict(_CONFIGS), "unet": 900.0,
+            **dict(_CONFIGS), "unet": 900.0, "train_loop": 240.0,
         }.get(forced, 300.0)
-        result = _run_child(forced, child_to, platform)
+        # The train_loop child composes axes — on a CPU target a bare
+        # host may expose one device, so give it the 8-virtual-device
+        # mesh (same treatment as the smoke path; a TPU target keeps
+        # its real devices).
+        extra = (
+            _cpu_virtual_env()
+            if forced == "train_loop" and platform in (None, "cpu")
+            else None
+        )
+        result = _run_child(forced, child_to, platform, extra)
         if result is None:
             # The failed config (and attempted platform) ride the record:
             # they are part of the JSONL merge key, so failures from
@@ -1716,6 +1982,13 @@ def main() -> None:
         )
         if scaling is not None:
             result["scaling"] = scaling
+    if remaining() > 150 and result["metric"] != "bench_failed":
+        # Per-axis composition legs (dp vs dp×fsdp vs dp×tp) on the CPU
+        # virtual mesh — the plan-composition proof, every leg on the
+        # real fused train_loop driver.
+        axes = _run_axis_bench(remaining())
+        if axes is not None:
+            result["parallel_axes"] = axes
 
     _emit_telemetry(result)
     print(json.dumps(result))

@@ -47,13 +47,33 @@ from fluxmpi_tpu.parallel import (
     fsdp_rule,
     make_train_step,
     shard_tree,
-    transformer_tp_rules,
 )
 from fluxmpi_tpu.parallel.pipeline import make_pipeline_fn, stack_stage_params
 from fluxmpi_tpu.parallel.train import shard_batch
 
 # ---------------------------------------------------------------- dp×sp×tp
-mesh = fm.init(mesh_shape={"dp": 2, "sp": 2, "tp": 2}, verbose=True)
+# ONE declarative plan: the mesh, the Megatron TP rule table, the batch
+# spec (batch over dp, sequence over sp), and the axis names every other
+# module resolves all come from it (docs/performance.md, "Choosing a
+# layout"). The pre-plan spelling — hand-built mesh_shape= plus
+# combine_rules/shard_tree/batch_spec restated per call — still works
+# (the MoE section below composes rules by hand) but is soft-deprecated.
+# Pass the UNRESOLVED config: init resolves it after the distributed
+# bring-up (resolving yourself first would lock the backend into a
+# single-process device view on a multi-host pod). Under the plan, ZeRO
+# parameter sharding lives on a dedicated fsdp axis (ParallelConfig(
+# fsdp=)); there is no room for one in this 2×2×2 layout, so the
+# rules= table — layered FIRST, ahead of the built-in TP rules — brings
+# the old hand-composed ZeRO-over-dp layer back for the one big leaf
+# the TP table leaves replicated.
+mesh = fm.init(
+    parallel=fm.ParallelConfig(
+        dp=2, sp=2, tp=2,
+        rules=[(r"pos_embed", jax.sharding.PartitionSpec("dp", None))],
+    ),
+    verbose=True,
+)
+plan = fm.global_plan()
 
 model = TransformerLM(
     vocab_size=256, max_len=64, num_layers=2, d_model=64, num_heads=4, d_ff=128
@@ -62,9 +82,9 @@ tokens = jnp.ones((4, 32), jnp.int32)
 params = fm.synchronize(model.init(jax.random.PRNGKey(0), tokens, train=False))
 opt = optax.adamw(3e-3)
 
-# Megatron TP layouts first, ZeRO/FSDP over dp for everything else.
-rule = combine_rules(transformer_tp_rules(), fsdp_rule(mesh, min_size=1024))
-state, shardings = shard_tree(TrainState.create(params, opt), mesh, rule)
+# The plan's rule engine lays out params AND optimizer state, and banks
+# the layout for make_train_step(parallel=).
+state, shardings = plan.shard_state(TrainState.create(params, opt))
 
 
 def lm_loss(p, mstate, batch):
@@ -73,14 +93,11 @@ def lm_loss(p, mstate, batch):
     return jnp.mean(optax.softmax_cross_entropy_with_integer_labels(logits, y)), mstate
 
 
-step = make_train_step(
-    lm_loss, opt, mesh=mesh, state_sharding=shardings, batch_spec=P("dp", "sp"),
-    remat=True,
-)
+step = make_train_step(lm_loss, opt, parallel=plan, remat=True)
 
 rng = np.random.default_rng(0)
 data = rng.integers(0, 256, size=(8, 33)).astype(np.int32)
-batch = shard_batch((data[:, :32], data[:, 1:]), mesh, spec=P("dp", "sp"))
+batch = shard_batch((data[:, :32], data[:, 1:]), mesh, spec=plan.batch_spec)
 for i in range(args.steps):
     state, loss = step(state, batch)
 fm.fluxmpi_println(f"dp×sp×tp TransformerLM: loss {float(loss):.4f}")

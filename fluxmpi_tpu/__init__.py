@@ -45,6 +45,7 @@ from .runtime import (  # noqa: F401
     device_count,
     dp_axis_name,
     global_mesh,
+    global_plan,
     init,
     install_preemption_handlers,
     is_initialized,
@@ -87,4 +88,8 @@ from .data import (  # noqa: F401,E402
     DistributedDataContainer,
     DistributedDataLoader,
     scan_batches,
+)
+from .parallel.plan import (  # noqa: F401,E402
+    ParallelConfig,
+    match_partition_rules,
 )

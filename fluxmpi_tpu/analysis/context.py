@@ -36,6 +36,7 @@ _DOC_ROW_RE = re.compile(r"^\s*\|")
 
 SCHEMA_RELPATH = os.path.join("fluxmpi_tpu", "telemetry", "schema.py")
 FAULTS_RELPATH = os.path.join("fluxmpi_tpu", "faults.py")
+CONFIG_RELPATH = os.path.join("fluxmpi_tpu", "config.py")
 ENV_DOC_RELPATH = os.path.join("docs", "observability.md")
 
 # Files outside the default scan set that legitimately read FLUXMPI_TPU_*
@@ -82,6 +83,45 @@ def known_fault_sites(repo_root: str) -> frozenset[str]:
         f"no KNOWN_SITES literal found in {path} — the fault-site "
         f"registry the unregistered-fault-site rule checks against"
     )
+
+
+def axis_name_literals(repo_root: str) -> frozenset[str]:
+    """The default mesh-axis names from ``fluxmpi_tpu/config.py``'s
+    ``_DEFAULTS`` literal (the ``*_axis_name`` rows), extracted
+    statically — the registry the hand-built-mesh rule checks axis-name
+    literals against. Single-sourced: a renamed default axis updates the
+    lint with no copy to drift."""
+    path = os.path.join(repo_root, CONFIG_RELPATH)
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        # `_DEFAULTS: dict[...] = {...}` is an AnnAssign; a bare
+        # `_DEFAULTS = {...}` would be an Assign — accept both.
+        if isinstance(node, ast.AnnAssign):
+            target = node.target
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+        else:
+            continue
+        if not (isinstance(target, ast.Name) and target.id == "_DEFAULTS"):
+            continue
+        if isinstance(node.value, ast.Dict):
+            for key, value in zip(node.value.keys, node.value.values):
+                if (
+                    isinstance(key, ast.Constant)
+                    and isinstance(key.value, str)
+                    and key.value.endswith("_axis_name")
+                    and isinstance(value, ast.Constant)
+                    and isinstance(value.value, str)
+                ):
+                    names.add(value.value)
+    if not names:
+        raise ValueError(
+            f"no *_axis_name defaults found in {path} — the axis-name "
+            f"registry the hand-built-mesh rule checks against"
+        )
+    return frozenset(names)
 
 
 def documented_env_vars(repo_root: str) -> dict[str, int]:
@@ -180,6 +220,7 @@ class ProjectContext:
         tests_corpus: str = "",
         env_doc_path: str = "docs/observability.md",
         faults_path: str = "fluxmpi_tpu/faults.py",
+        axis_name_literals: frozenset[str] = frozenset(),
     ):
         self.known_metric_names = known_metric_names
         self.closed_namespaces = closed_namespaces
@@ -192,6 +233,7 @@ class ProjectContext:
         self.tests_corpus = tests_corpus
         self.env_doc_path = env_doc_path
         self.faults_path = faults_path
+        self.axis_name_literals = axis_name_literals
 
     @classmethod
     def load(cls, repo_root: str) -> "ProjectContext":
@@ -214,4 +256,5 @@ class ProjectContext:
             documented_env_vars=documented_env_vars(repo_root),
             extra_env_vars=extra,
             tests_corpus=tests_corpus(repo_root),
+            axis_name_literals=axis_name_literals(repo_root),
         )

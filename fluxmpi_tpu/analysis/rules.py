@@ -1,4 +1,4 @@
-"""The fluxlint rule set — five invariants this repo has paid for.
+"""The fluxlint rule set — six invariants this repo has paid for.
 
 Each rule's docstring names the contract it enforces and the bug class
 (from CHANGES.md history) that motivates it; docs/static_analysis.md
@@ -808,7 +808,148 @@ class UnregisteredFaultSite(Rule):
 
 
 # ---------------------------------------------------------------------------
-# Rule 5: undocumented env var
+# Rule 5: hand-built mesh / hard-coded axis names
+# ---------------------------------------------------------------------------
+
+# Call names whose string arguments ARE mesh axis names: the sharding
+# spec constructors and the in-jit collectives bound to a named axis.
+_AXIS_CONSUMER_NAMES = frozenset({"P", "PartitionSpec"})
+_AXIS_COLLECTIVE_ATTRS = frozenset(
+    {
+        "psum",
+        "pmean",
+        "pmax",
+        "pmin",
+        "ppermute",
+        "pshuffle",
+        "all_gather",
+        "all_to_all",
+        "axis_index",
+        "axis_size",
+    }
+)
+# Keyword names that carry an axis name in any call signature.
+_AXIS_KEYWORDS = frozenset(
+    {
+        "axis_name",
+        "batch_axis_name",
+        "dp_axis",
+        "fsdp_axis",
+        "tp_axis",
+        "pp_axis",
+        "sp_axis",
+        "ep_axis",
+    }
+)
+
+
+class HandBuiltMesh(Rule):
+    """The ParallelConfig composition contract (parallel/plan.py): ONE
+    mesh, resolved from ONE declarative plan — framework modules must
+    not regrow private meshes or hard-code mesh-axis-name literals, the
+    island-forming habit the plan engine exists to end (each of
+    sharding/pipeline/ring/ulysses once built its own mesh and axis
+    names, so ``dp × fsdp × tp × pp × sp`` could not compose).
+
+    Flagged, for modules under ``fluxmpi_tpu/`` other than the plan
+    engine itself (``parallel/plan.py``), the runtime (``runtime.py`` —
+    the one place the global mesh is constructed), and the axis-name
+    registry (``config.py``):
+
+    1. any ``Mesh(...)`` construction;
+    2. a default-axis-name literal (the ``*_axis_name`` values of
+       ``config._DEFAULTS`` — ``"dp"``/``"tp"``/... today) passed to a
+       ``PartitionSpec``/``P`` constructor, a named-axis collective
+       (``jax.lax.psum`` and friends), or any ``axis_name=``-family
+       keyword. Spell it ``config.DP_AXIS_NAME`` (or resolve it from
+       the plan via ``plan_axis_name``) so a renamed axis — or a
+       composed plan with different names — reaches every module.
+    """
+
+    id = "hand-built-mesh"
+    severity = "error"
+    description = "hand-built Mesh / hard-coded axis-name literal outside plan.py"
+
+    _ALLOWED = (
+        "fluxmpi_tpu/parallel/plan.py",
+        "fluxmpi_tpu/runtime.py",
+        "fluxmpi_tpu/config.py",
+    )
+
+    def check(self, module: ModuleSource, ctx: Any) -> Iterator[Finding]:
+        if not module.path.startswith("fluxmpi_tpu/"):
+            return
+        if module.path in self._ALLOWED:
+            return
+        axis_literals = getattr(ctx, "axis_name_literals", frozenset())
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = terminal_name(func)
+            if name == "Mesh":
+                yield self.finding(
+                    module.path,
+                    node,
+                    f"hand-built jax.sharding.Mesh in {module.path} — "
+                    f"meshes come from ONE ParallelConfig "
+                    f"(fluxmpi_tpu.init(parallel=) / "
+                    f"ParallelConfig.resolve()); a private mesh re-forms "
+                    f"the parallelism islands the plan engine removed",
+                    "mesh",
+                )
+                continue
+            if not axis_literals:
+                continue
+            # Both spellings consume axis names: jax.lax.psum(x, "dp")
+            # (Attribute) and `from jax.lax import psum; psum(x, "dp")`
+            # (Name).
+            consumes_axes = (
+                name in _AXIS_CONSUMER_NAMES
+                or name in _AXIS_COLLECTIVE_ATTRS
+                or (
+                    isinstance(func, ast.Attribute)
+                    and (
+                        func.attr in _AXIS_COLLECTIVE_ATTRS
+                        or func.attr in _AXIS_CONSUMER_NAMES
+                    )
+                )
+            )
+            checked: list[ast.expr] = []
+            if consumes_axes:
+                checked.extend(node.args)
+            for kw in node.keywords:
+                if kw.arg in _AXIS_KEYWORDS:
+                    checked.append(kw.value)
+            for arg in checked:
+                for lit in self._axis_literals_in(arg, axis_literals):
+                    yield self.finding(
+                        module.path,
+                        lit,
+                        f"hard-coded mesh axis name {lit.value!r} — use "
+                        f"the config *_AXIS_NAME constant (or "
+                        f"plan_axis_name) so composed ParallelConfig "
+                        f"layouts and renamed axes reach this call",
+                        f"axis:{lit.value}",
+                    )
+
+    @staticmethod
+    def _axis_literals_in(
+        expr: ast.expr, axis_literals: frozenset[str]
+    ) -> Iterator[ast.Constant]:
+        if isinstance(expr, ast.Constant) and expr.value in axis_literals:
+            yield expr
+        elif isinstance(expr, (ast.Tuple, ast.List)):
+            for elt in expr.elts:
+                if (
+                    isinstance(elt, ast.Constant)
+                    and elt.value in axis_literals
+                ):
+                    yield elt
+
+
+# ---------------------------------------------------------------------------
+# Rule 6: undocumented env var
 # ---------------------------------------------------------------------------
 
 
@@ -878,5 +1019,6 @@ def default_rules() -> list[Rule]:
         UnguardedHotPathInstrumentation(),
         UnknownMetricName(),
         UnregisteredFaultSite(),
+        HandBuiltMesh(),
         UndocumentedEnvVar(),
     ]

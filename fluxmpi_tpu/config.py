@@ -41,6 +41,10 @@ _DEFAULTS: dict[str, Any] = {
     "donate_buffers": True,
     # Default name of the data-parallel mesh axis.
     "dp_axis_name": "dp",
+    # Default name of the FSDP/ZeRO mesh axis (parameter + optimizer
+    # sharding in a composed ParallelConfig; dp-only layouts shard over
+    # the data axis instead — see parallel/plan.py).
+    "fsdp_axis_name": "fsdp",
     # Default name of the sequence-parallel mesh axis (ring attention).
     "sp_axis_name": "sp",
     # Default name of the tensor-parallel mesh axis (sharded matmuls).
@@ -195,6 +199,7 @@ def _warn_deprecated_env() -> None:
 _warn_deprecated_env()
 DEVICE_COLLECTIVES_DISABLED: bool = bool(load_preference("disable_device_collectives"))
 DP_AXIS_NAME: str = str(load_preference("dp_axis_name"))
+FSDP_AXIS_NAME: str = str(load_preference("fsdp_axis_name"))
 SP_AXIS_NAME: str = str(load_preference("sp_axis_name"))
 TP_AXIS_NAME: str = str(load_preference("tp_axis_name"))
 EP_AXIS_NAME: str = str(load_preference("ep_axis_name"))

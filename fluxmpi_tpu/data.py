@@ -182,6 +182,14 @@ def _stack_samples(samples: Sequence[Any]) -> Any:
     return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *samples)
 
 
+def _data_axis_size(mesh: Any, axis: Any) -> int:
+    """Total device count along the loader's batch axis — a single mesh
+    axis, or the product of a composed plan's data-axis tuple."""
+    if isinstance(axis, tuple):
+        return int(np.prod([mesh.shape.get(a, 1) for a in axis]))
+    return mesh.shape.get(axis, 1)
+
+
 class DistributedDataLoader:
     """Iterate global, mesh-sharded batches from per-process data.
 
@@ -199,7 +207,10 @@ class DistributedDataLoader:
         ``process_count`` (and the per-process batch by the local device
         count for even device layout).
       mesh: defaults to the runtime's global mesh.
-      axis_name: mesh axis to shard the batch dimension over.
+      axis_name: mesh axis (or tuple of axes — a composed
+        ``ParallelConfig``'s ``dp × fsdp`` data axes) to shard the batch
+        dimension over. Default: the installed plan's data axes when
+        ``init(parallel=)`` built the mesh, else the ``dp`` preference.
       shuffle/seed: reshuffle shard indices each epoch with a per-epoch key.
       global_shuffle: reshuffle the assignment of samples to workers each
         epoch — a seeded permutation of the FULL dataset, of which this
@@ -339,7 +350,25 @@ class DistributedDataLoader:
                 )
         self.data = data
         self.mesh = mesh
-        self.axis_name = axis_name or config.DP_AXIS_NAME
+        if axis_name is None:
+            from .runtime import global_plan
+
+            plan = global_plan()
+            # The plan's data axes are the default ONLY when this loader
+            # rides the plan's own mesh (mesh=None → the global mesh, or
+            # an explicit mesh carrying the plan's axes); an ad-hoc
+            # mesh= without those axes falls back to the dp preference
+            # rather than constructing a spec its mesh cannot express.
+            if plan is not None and plan.covers(mesh):
+                axes = plan.data_axes
+                axis_name = axes[0] if len(axes) == 1 else axes
+            else:
+                axis_name = config.DP_AXIS_NAME
+        elif isinstance(axis_name, (list, tuple)):
+            axis_name = (
+                axis_name[0] if len(axis_name) == 1 else tuple(axis_name)
+            )
+        self.axis_name = axis_name
         if global_batch_size % jax.process_count() != 0:
             raise ValueError(
                 f"global_batch_size {global_batch_size} must divide evenly "
@@ -355,7 +384,7 @@ class DistributedDataLoader:
                 mesh_for_check = None
         if mesh_for_check is not None:
             axis = self.axis_name
-            axis_size = mesh_for_check.shape.get(axis, 1)
+            axis_size = _data_axis_size(mesh_for_check, axis)
             if global_batch_size % axis_size != 0:
                 raise ValueError(
                     f"global_batch_size {global_batch_size} must be divisible "
@@ -476,7 +505,7 @@ class DistributedDataLoader:
             remainder = self._common_len % self.local_batch_size
             global_remainder = remainder * jax.process_count()
             axis_size = (
-                mesh_for_check.shape.get(self.axis_name, 1)
+                _data_axis_size(mesh_for_check, self.axis_name)
                 if mesh_for_check is not None
                 else 1
             )

@@ -21,6 +21,12 @@ from .ring import (  # noqa: F401
     zigzag_indices,
     zigzag_ring_attention,
 )
+from .plan import (  # noqa: F401
+    ParallelConfig,
+    ResolvedPlan,
+    match_partition_rules,
+    plan_axis_name,
+)
 from .sharding import (  # noqa: F401
     combine_rules,
     fsdp_rule,

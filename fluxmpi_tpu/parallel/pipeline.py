@@ -67,8 +67,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .. import config
 from ._compat import shard_map_unchecked
+from .plan import plan_axis_name
 
 __all__ = [
     "pipeline_apply",
@@ -155,7 +155,7 @@ def stack_stage_params(
 def pipeline_rules(pp_axis: str | None = None):
     """Sharding rule for stacked stage parameters: leading (stage) dimension
     over the ``pp`` mesh axis, everything else replicated."""
-    name = pp_axis or config.PP_AXIS_NAME
+    name = pp_axis or plan_axis_name("pp")
 
     def rule(path: str, shape: tuple[int, ...]):
         if not shape:
@@ -209,7 +209,7 @@ def pipeline_apply(
     ``remat_stages=True`` wraps each stage call in ``jax.checkpoint`` —
     the 1F1B-equivalent activation-memory bound (see module docstring).
     """
-    axis_name = axis_name or config.PP_AXIS_NAME
+    axis_name = axis_name or plan_axis_name("pp")
     n_stages = jax.lax.axis_size(axis_name)
     stage_idx = jax.lax.axis_index(axis_name)
     v = int(interleave)
@@ -393,7 +393,7 @@ def make_pipeline_fn(
     from ..runtime import global_mesh
 
     mesh = mesh or global_mesh()
-    axis_name = axis_name or config.PP_AXIS_NAME
+    axis_name = axis_name or plan_axis_name("pp")
     v = int(interleave)
 
     def body(stacked_params, x):

@@ -1,0 +1,561 @@
+"""Declarative N-D parallelism: one ``ParallelConfig`` → one mesh + one rule.
+
+The parallelism surface grew as islands — :mod:`.sharding` (FSDP/TP
+rules), :mod:`.pipeline` (GPipe), :mod:`.ring`/:mod:`.ulysses` (sequence
+parallelism) — and each built its own mesh and axis names, so
+``dp × fsdp × tp × pp × sp`` could not compose in one program. This
+module is the composition engine, following GSPMD (Xu et al., 2021):
+ONE annotated program over ONE mesh, the partitioner inserts the
+collectives; ZeRO (Rajbhandari et al., 2020) supplies the sharded
+optimizer axis.
+
+- :class:`ParallelConfig` declares axis sizes (``dp=``, ``fsdp=``,
+  ``tp=``, ``pp=``, ``sp=``, ``ep=``; one may be ``-1``, inferred from
+  the device count) plus an optional regex partition-rule table.
+- :meth:`ParallelConfig.resolve` validates the topology against the
+  devices (:class:`~fluxmpi_tpu.errors.TopologyMismatchError` when the
+  axes cannot cover them) and returns a :class:`ResolvedPlan`: exactly
+  one :class:`~jax.sharding.Mesh` in canonical axis order (``dp``
+  outermost — the DCN-friendly axis — ``tp`` innermost, riding the
+  fastest ICI), the combined partition rule (user table first, then the
+  Megatron TP table when ``tp`` is present, then the ZeRO rule when
+  ``fsdp`` is), the batch spec, and per-source rule-hit counts for the
+  PARALLEL observability board.
+- :func:`match_partition_rules` is the strict SNIPPETS-shaped engine: a
+  rule table applied to a whole tree where an unmatched non-scalar leaf
+  RAISES instead of silently replicating.
+
+Every consumer derives from the plan instead of restating it:
+``fluxmpi_tpu.init(parallel=)`` builds the global mesh from it,
+``make_train_step(parallel=)`` takes mesh/axis-names/batch-spec/state
+sharding from it, pipeline/ring/ulysses resolve their default axis
+names through :func:`plan_axis_name`, checkpoints record it in the
+manifest and ``restore_checkpoint(parallel=)`` accepts it in place of
+``(mesh=, rule=)``. See docs/performance.md, "Choosing a layout".
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import config
+from ..errors import TopologyMismatchError
+from .sharding import (
+    Rule,
+    _path_str,
+    _validated,
+    fsdp_rule,
+    rule_from_table,
+    transformer_tp_rules,
+)
+
+__all__ = [
+    "ParallelConfig",
+    "ResolvedPlan",
+    "match_partition_rules",
+    "plan_axis_name",
+]
+
+# Canonical mesh-axis order: dp outermost (the axis that can span slower
+# links), tp innermost (two all-reduces per block — wants the fastest
+# ICI, ahead of ep's one all-to-all per MoE layer); fsdp next to dp (it
+# is a data axis for the batch), pp/sp between.
+_PLAN_AXES = ("dp", "fsdp", "pp", "sp", "ep", "tp")
+
+# Axes whose devices consume distinct batch shards (the batch's leading
+# dimension is laid out over their product).
+_DATA_AXES = ("dp", "fsdp")
+
+
+def _default_axis_name(kind: str) -> str:
+    return {
+        "dp": config.DP_AXIS_NAME,
+        "fsdp": config.FSDP_AXIS_NAME,
+        "pp": config.PP_AXIS_NAME,
+        "sp": config.SP_AXIS_NAME,
+        "tp": config.TP_AXIS_NAME,
+        "ep": config.EP_AXIS_NAME,
+    }[kind]
+
+
+def _is_scalar_shape(shape: tuple[int, ...]) -> bool:
+    """SNIPPETS [2] semantics: scalars and single-element leaves are
+    never partitioned (and never need a rule)."""
+    return len(shape) == 0 or int(np.prod(shape)) == 1
+
+
+def match_partition_rules(rules: Any, tree: Any) -> Any:
+    """Apply a ``(regex, PartitionSpec)`` table (or any
+    :data:`~fluxmpi_tpu.parallel.sharding.Rule`) to a whole pytree,
+    STRICTLY: every non-scalar leaf must match some rule — an unmatched
+    path raises ``ValueError`` naming it, so a renamed layer can never
+    silently fall back to replicated (the failure mode the warn-and-
+    degrade :func:`~fluxmpi_tpu.parallel.sharding.tree_partition_specs`
+    tolerates at model-build time). Scalar / single-element leaves get
+    ``P()`` without consulting the table. Returns a pytree of
+    :class:`~jax.sharding.PartitionSpec`."""
+    rule = rules if callable(rules) else rule_from_table(list(rules))
+
+    def get_spec(path, leaf):
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        if _is_scalar_shape(shape):
+            return P()
+        name = _path_str(path)
+        spec = rule(name, shape)
+        if spec is None:
+            raise ValueError(
+                f"partition rule not found for parameter {name!r} "
+                f"(shape {shape}) — add a table entry or use the "
+                f"non-strict tree_partition_specs for heuristic layouts"
+            )
+        return spec
+
+    return jax.tree_util.tree_map_with_path(get_spec, tree)
+
+
+class ParallelConfig:
+    """Declarative N-D parallel layout: axis sizes + partition rules.
+
+    Args:
+      dp: data-parallel axis size (batch sharding; replicated params
+        unless ``fsdp``/``tp``/``rules`` shard them).
+      fsdp: ZeRO-3 axis size — parameters AND optimizer state sharded
+        over it (largest divisible dim of every leaf ≥
+        ``fsdp_min_size``); its devices also consume distinct batch
+        shards, so the effective data parallelism is ``dp × fsdp``.
+      tp: Megatron tensor-parallel axis size — the built-in transformer
+        table (:func:`~fluxmpi_tpu.parallel.sharding.transformer_tp_rules`)
+        applies when > 1.
+      pp: GPipe pipeline axis size (:mod:`~fluxmpi_tpu.parallel.pipeline`
+        resolves its axis name from the plan).
+      sp: sequence-parallel axis size (ring/Ulysses attention; the batch
+        spec shards the sequence dimension over it).
+      ep: expert-parallel axis size (MoE).
+
+      Exactly one size may be ``-1`` — inferred from the device count at
+      :meth:`resolve` time. All sizes left at 1 means "dp over every
+      device" (``dp=-1``).
+
+      rules: optional user partition rules — a ``(regex, PartitionSpec)``
+        table or a :data:`~fluxmpi_tpu.parallel.sharding.Rule` — layered
+        FIRST (they win over the built-in TP table and FSDP fallback).
+      strict: when True, :meth:`ResolvedPlan.partition_specs` raises on
+        a non-scalar leaf no rule matched (the
+        :func:`match_partition_rules` discipline) instead of counting it
+        replicated.
+      fsdp_min_size: leaves smaller than this stay replicated under the
+        fsdp axis (collective latency would outweigh the memory).
+      axis_names: optional ``{plan axis: mesh axis name}`` overrides;
+        defaults come from the ``*_axis_name`` preferences.
+    """
+
+    def __init__(
+        self,
+        *,
+        dp: int = 1,
+        fsdp: int = 1,
+        tp: int = 1,
+        pp: int = 1,
+        sp: int = 1,
+        ep: int = 1,
+        rules: Any = None,
+        strict: bool = False,
+        fsdp_min_size: int = 1024,
+        axis_names: dict[str, str] | None = None,
+    ):
+        sizes = {"dp": dp, "fsdp": fsdp, "tp": tp, "pp": pp, "sp": sp,
+                 "ep": ep}
+        for axis, size in sizes.items():
+            if not isinstance(size, int) or isinstance(size, bool) or (
+                size < 1 and size != -1
+            ):
+                raise ValueError(
+                    f"ParallelConfig {axis}= must be a positive int or -1 "
+                    f"(inferred), got {size!r}"
+                )
+        if sum(1 for s in sizes.values() if s == -1) > 1:
+            raise ValueError(
+                "at most one ParallelConfig axis may have inferred size -1"
+            )
+        if all(s == 1 for s in sizes.values()):
+            sizes["dp"] = -1  # the default 1-D data-parallel mesh
+        self.sizes = sizes
+        self.rules = rules
+        self.strict = bool(strict)
+        self.fsdp_min_size = int(fsdp_min_size)
+        names = {axis: _default_axis_name(axis) for axis in _PLAN_AXES}
+        if axis_names:
+            unknown = set(axis_names) - set(_PLAN_AXES)
+            if unknown:
+                raise ValueError(
+                    f"axis_names keys must be plan axes {_PLAN_AXES}, "
+                    f"got {sorted(unknown)}"
+                )
+            names.update(axis_names)
+        if len(set(names.values())) != len(names):
+            raise ValueError(
+                f"mesh axis names must be distinct, got {names}"
+            )
+        self.axis_names = names
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        axes = ", ".join(
+            f"{a}={s}" for a, s in self.sizes.items() if s != 1
+        )
+        return f"ParallelConfig({axes})"
+
+    def resolve(
+        self, devices: Sequence[jax.Device] | int | None = None
+    ) -> "ResolvedPlan":
+        """Resolve against ``devices`` (a device list, a count, or None
+        for all global devices): infer the one ``-1`` axis, validate
+        coverage, and return the :class:`ResolvedPlan` carrying the ONE
+        mesh every consumer shares. Raises
+        :class:`~fluxmpi_tpu.errors.TopologyMismatchError` when the axis
+        sizes cannot cover the device count exactly."""
+        if devices is None:
+            devices = jax.devices()
+        if isinstance(devices, int):
+            n_dev = devices
+            devs = jax.devices()[:n_dev]
+            if len(devs) < n_dev:
+                raise TopologyMismatchError(
+                    f"ParallelConfig asks for {n_dev} devices but only "
+                    f"{len(devs)} are visible"
+                )
+        else:
+            devs = list(devices)
+            n_dev = len(devs)
+        sizes = dict(self.sizes)
+        known = int(np.prod([s for s in sizes.values() if s != -1]))
+        if -1 in sizes.values():
+            if known == 0 or n_dev % known:
+                raise TopologyMismatchError(
+                    f"cannot infer the -1 axis of {self._spec_str()}: "
+                    f"{n_dev} device(s) not divisible by the known axes' "
+                    f"product {known}"
+                )
+            for axis, size in sizes.items():
+                if size == -1:
+                    sizes[axis] = n_dev // known
+        total = int(np.prod(list(sizes.values())))
+        if total != n_dev:
+            raise TopologyMismatchError(
+                f"ParallelConfig {self._spec_str()} covers {total} "
+                f"device(s) but {n_dev} are available — resize an axis "
+                f"(or set one to -1 to infer it)"
+            )
+        return ResolvedPlan(self, sizes, devs)
+
+    def _spec_str(self) -> str:
+        return (
+            "("
+            + ", ".join(
+                f"{a}={s}" for a, s in self.sizes.items() if s != 1
+            )
+            + ")"
+        )
+
+
+class ResolvedPlan:
+    """A :class:`ParallelConfig` bound to concrete devices: the ONE mesh,
+    the combined partition rule (with per-source hit counts), the batch
+    spec, and the state-sharding bank ``make_train_step(parallel=)``
+    consumes. Built by :meth:`ParallelConfig.resolve`."""
+
+    def __init__(
+        self,
+        cfg: ParallelConfig,
+        sizes: dict[str, int],
+        devices: Sequence[jax.Device],
+    ):
+        self.config = cfg
+        # Mesh axes: every plan axis with size > 1, in canonical order;
+        # dp always rides along (size 1 if unused) so there is always a
+        # data axis for batch specs and the loader.
+        mesh_axes = [
+            axis for axis in _PLAN_AXES if sizes[axis] > 1 or axis == "dp"
+        ]
+        self.sizes = {axis: int(sizes[axis]) for axis in mesh_axes}
+        self.axis_names = {
+            axis: cfg.axis_names[axis] for axis in mesh_axes
+        }
+        shape = [self.sizes[axis] for axis in mesh_axes]
+        self.mesh = Mesh(
+            np.asarray(devices).reshape(shape),
+            tuple(self.axis_names[axis] for axis in mesh_axes),
+        )
+        self.rule_hits: dict[str, int] = {}
+        self._rule = self._build_rule()
+        self._state_sharding: Any | None = None
+
+    # -- axis queries ---------------------------------------------------
+
+    def axis_name(self, kind: str) -> str | None:
+        """Mesh axis name for plan axis ``kind`` (``"dp"``/``"fsdp"``/
+        ``"tp"``/``"pp"``/``"sp"``/``"ep"``), or None when the plan does
+        not have that axis."""
+        return self.axis_names.get(kind)
+
+    @property
+    def dp_axis_name(self) -> str:
+        return self.axis_names["dp"]
+
+    @property
+    def data_axes(self) -> tuple[str, ...]:
+        """Mesh axis names whose devices consume distinct batch shards
+        (``dp``, plus ``fsdp`` when present — ZeRO devices are data
+        workers too)."""
+        return tuple(
+            self.axis_names[axis]
+            for axis in _DATA_AXES
+            if axis in self.axis_names
+        )
+
+    def covers(self, mesh: Any) -> bool:
+        """Does ``mesh`` carry this plan's data axes (None = the plan's
+        own mesh)? THE gate both halves of the batch contract share —
+        the loader's default batch axes and the step factories'
+        installed-plan defaults must agree on it, so neither inlines
+        its own copy."""
+        return mesh is None or set(self.data_axes) <= set(mesh.axis_names)
+
+    @property
+    def data_parallel_size(self) -> int:
+        """Distinct batch shards = effective data-parallel worker count."""
+        return int(
+            np.prod([self.mesh.shape[name] for name in self.data_axes])
+        )
+
+    @property
+    def batch_spec(self) -> P:
+        """The batch layout the plan implies: leading (batch) dim over
+        the data axes, sequence dim (axis 1) over ``sp`` when present."""
+        axes = self.data_axes
+        lead = axes[0] if len(axes) == 1 else axes
+        if "sp" in self.axis_names:
+            return P(lead, self.axis_names["sp"])
+        return P(lead)
+
+    @property
+    def shards_parameters(self) -> bool:
+        """Does this plan lay parameters out non-replicated (fsdp/tp
+        axes or user rules)? When True, ``make_train_step(parallel=)``
+        requires :meth:`shard_state` to have produced the layout."""
+        return (
+            "fsdp" in self.axis_names
+            or "tp" in self.axis_names
+            or self.config.rules is not None
+        )
+
+    # -- the rule engine ------------------------------------------------
+
+    def _build_rule(self) -> Rule:
+        components: list[tuple[str, Rule]] = []
+        user = self.config.rules
+        if user is not None:
+            components.append(
+                ("table", user if callable(user) else rule_from_table(
+                    list(user)))
+            )
+        if "tp" in self.axis_names:
+            components.append(
+                ("tp", transformer_tp_rules(tp_axis=self.axis_names["tp"]))
+            )
+        if "fsdp" in self.axis_names:
+            components.append(
+                (
+                    "fsdp",
+                    fsdp_rule(
+                        self.mesh,
+                        axis_name=self.axis_names["fsdp"],
+                        min_size=self.config.fsdp_min_size,
+                    ),
+                )
+            )
+        self._components = components
+
+        def rule(path: str, shape: tuple[int, ...]) -> P | None:
+            match = self._match(path, shape)
+            return match[1] if match else None
+
+        return rule
+
+    def _match(
+        self, path: str, shape: tuple[int, ...]
+    ) -> tuple[str, P] | None:
+        """First component with an opinion → ``(source, spec)``."""
+        for source, component in self._components:
+            spec = component(path, shape)
+            if spec is not None:
+                return source, spec
+        return None
+
+    @property
+    def rule(self) -> Rule:
+        """The combined partition rule (user table → TP table → FSDP
+        fallback; first opinion wins). ``None`` for unmatched paths —
+        feed it to :func:`~fluxmpi_tpu.parallel.sharding.shard_tree`,
+        ``restore_checkpoint(rule=)``, etc. Direct invocations do NOT
+        touch ``rule_hits`` — only :meth:`partition_specs` counts, so a
+        restore walking the rule never pollutes the board's per-tree
+        numbers."""
+        return self._rule
+
+    def partition_specs(self, tree: Any) -> Any:
+        """Map the plan's rule over ``tree`` → validated PartitionSpecs.
+        Scalar leaves get ``P()``; unmatched non-scalar leaves raise
+        under ``strict=True`` (no silent replication), otherwise count
+        into ``rule_hits["replicated"]``."""
+        mesh = self.mesh
+        strict = self.config.strict
+        # Fresh counts per application: the board reports the LAST tree
+        # laid out, not a lifetime accumulation (a warmup + timed run
+        # pair must not double the "how many leaves each axis claimed"
+        # numbers operators read).
+        self.rule_hits = {}
+        hits = self.rule_hits
+
+        def leaf_spec(path, leaf):
+            shape = tuple(getattr(leaf, "shape", ()) or ())
+            if _is_scalar_shape(shape):
+                return P()
+            name = _path_str(path)
+            match = self._match(name, shape)
+            if match is None:
+                if strict:
+                    raise ValueError(
+                        f"partition rule not found for parameter "
+                        f"{name!r} (shape {shape}) under strict "
+                        f"ParallelConfig — add a rules= entry or drop "
+                        f"strict=True"
+                    )
+                hits["replicated"] = hits.get("replicated", 0) + 1
+                return P()
+            source, spec = match
+            hits[source] = hits.get(source, 0) + 1
+            return _validated(spec, shape, mesh, path=name)
+
+        return jax.tree_util.tree_map_with_path(leaf_spec, tree)
+
+    def shard_state(self, state: Any) -> tuple[Any, Any]:
+        """Lay a :class:`~fluxmpi_tpu.parallel.TrainState` (or any
+        pytree — optimizer state included, via the path-suffix
+        convention) out over the plan's mesh. Returns
+        ``(placed, shardings)`` and BANKS the shardings so
+        ``make_train_step(parallel=plan)`` picks them up without
+        restating them. Also refreshes the PARALLEL observability
+        board (rule hit counts per source)."""
+        specs = self.partition_specs(state)
+        shardings = jax.tree_util.tree_map(
+            lambda spec: NamedSharding(self.mesh, spec),
+            specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        # One batched placement for the whole tree (device_put accepts a
+        # pytree of shardings), not a transfer dispatch per leaf.
+        placed = jax.device_put(state, shardings)
+        self._state_sharding = shardings
+        post_board(self)
+        return placed, shardings
+
+    @property
+    def state_sharding(self) -> Any | None:
+        """The shardings banked by the last :meth:`shard_state` call
+        (None before any)."""
+        return self._state_sharding
+
+    # -- description (manifest / status board) -------------------------
+
+    def describe(self) -> dict[str, Any]:
+        """JSON-able description: plan axis sizes, the plan→mesh axis
+        name map, the resolved mesh shape, and the per-source rule hit
+        counts — what the manifest's ``parallel`` section and the
+        ``/status`` PARALLEL board carry."""
+        return {
+            "axes": dict(self.sizes),
+            "axis_names": dict(self.axis_names),
+            "mesh": {
+                str(name): int(size)
+                for name, size in self.mesh.shape.items()
+            },
+            "data_parallel_size": self.data_parallel_size,
+            "rule_hits": dict(self.rule_hits),
+        }
+
+
+def resolve_parallel(parallel: Any) -> ResolvedPlan:
+    """Normalize a ``parallel=`` argument: a :class:`ResolvedPlan` passes
+    through; a :class:`ParallelConfig` returns the installed plan when it
+    IS the installed plan's config, else resolves against the runtime's
+    mesh devices (all global devices pre-``init``). The one coercion
+    every ``parallel=``-accepting entry point shares — resolving against
+    the mesh the state actually lives on, so ``init(devices=subset,
+    parallel=cfg)`` followed by ``make_train_step(parallel=cfg)`` derives
+    the SAME mesh instead of silently rebuilding over all devices."""
+    if isinstance(parallel, ResolvedPlan):
+        return parallel
+    if isinstance(parallel, ParallelConfig):
+        from ..runtime import global_mesh, global_plan, is_initialized
+
+        installed = global_plan()
+        if installed is not None and parallel is installed.config:
+            return installed
+        if is_initialized():
+            return parallel.resolve(list(global_mesh().devices.flat))
+        return parallel.resolve()
+    raise ValueError(
+        f"parallel= must be a ParallelConfig or ResolvedPlan, got "
+        f"{parallel!r}"
+    )
+
+
+def plan_axis_name(kind: str) -> str:
+    """Default mesh axis name for plan axis ``kind``: the runtime's
+    installed plan wins (``init(parallel=)``), else the ``*_axis_name``
+    preference — how pipeline/ring/ulysses resolve their axis names
+    from the ONE plan instead of hard-coding literals."""
+    from ..runtime import global_plan
+
+    plan = global_plan()
+    if plan is not None:
+        name = plan.axis_name(kind)
+        if name is not None:
+            return name
+    return _default_axis_name(kind)
+
+
+def post_board(plan: ResolvedPlan) -> None:
+    """Publish the PARALLEL board: the resolved mesh/axis sizes and rule
+    hit counts onto the live ``/status`` endpoint (when the exporter is
+    serving) and the ``parallel.*`` gauges into the default registry
+    (when telemetry is on). Zero-cost when both planes are off — two
+    attribute reads."""
+    from ..telemetry import get_registry
+    from ..telemetry import export as _export
+
+    desc = plan.describe()
+    exporter = _export.get_exporter()
+    if exporter is not None and exporter.enabled:
+        exporter.note_parallel(**desc)
+    registry = get_registry()
+    if registry is not None and getattr(registry, "enabled", True):
+        for axis, size in desc["mesh"].items():
+            registry.gauge("parallel.axis_size", axis=axis).set(
+                float(size)
+            )
+        # Every known source posts every time (absent → 0): a re-layout
+        # where e.g. the user table stops matching must zero its gauge,
+        # not leave the last count standing.
+        sources = {"table", "tp", "fsdp", "replicated"} | set(
+            desc["rule_hits"]
+        )
+        for source in sources:
+            registry.gauge("parallel.rule_hits", source=source).set(
+                float(desc["rule_hits"].get(source, 0))
+            )

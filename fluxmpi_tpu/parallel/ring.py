@@ -42,8 +42,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .. import config
 from ._compat import shard_map_unchecked
+from .plan import plan_axis_name
 
 __all__ = [
     "ring_attention",
@@ -421,7 +421,7 @@ def ring_attention(
             "dropout_rate > 0 requires dropout_seed (an int or traced "
             "uint32 scalar)"
         )
-    name = axis_name or config.SP_AXIS_NAME
+    name = axis_name or plan_axis_name("sp")
     try:
         n = jax.lax.axis_size(name)
     except NameError:
@@ -594,7 +594,7 @@ def zigzag_ring_attention(
             "dropout_rate > 0 requires dropout_seed (an int or traced "
             "uint32 scalar)"
         )
-    name = axis_name or config.SP_AXIS_NAME
+    name = axis_name or plan_axis_name("sp")
     try:
         n = jax.lax.axis_size(name)
     except NameError:
@@ -805,7 +805,7 @@ def make_ring_attention(
         )
 
     mesh = mesh or global_mesh()
-    sp = axis_name or config.SP_AXIS_NAME
+    sp = axis_name or plan_axis_name("sp")
     dp = batch_axis_name
     spec = P(dp, sp)
 

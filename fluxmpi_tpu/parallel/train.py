@@ -275,10 +275,69 @@ def _instrument_step(
     return step
 
 
+def _plan_defaults(
+    parallel: Any,
+    mesh: Mesh | None,
+    axis_name: str | None,
+    batch_spec: Any | None,
+    state_sharding: Any | None,
+    caller: str,
+) -> tuple[Any, Mesh, str, Any, Any]:
+    """Derive a step factory's layout defaults from a ``parallel=``
+    argument (explicit arguments win): the plan's mesh, dp axis name,
+    batch spec, and BANKED state sharding. A parameter-sharding plan
+    with nothing banked raises — the step must pin the same layout the
+    state was placed with."""
+    from .plan import resolve_parallel
+
+    plan = resolve_parallel(parallel)
+    mesh = mesh or plan.mesh
+    if axis_name is None:
+        axis_name = plan.dp_axis_name
+    if batch_spec is None:
+        batch_spec = plan.batch_spec
+    if state_sharding is None:
+        state_sharding = plan.state_sharding
+        if state_sharding is None and plan.shards_parameters:
+            raise ValueError(
+                f"this ParallelConfig shards parameters (fsdp/tp axes or "
+                f"a rules table) but no layout is banked — call "
+                f"plan.shard_state(state) before {caller}(parallel=plan) "
+                f"so the compiled program pins the same layout the state "
+                f"was placed with"
+            )
+    return plan, mesh, axis_name, batch_spec, state_sharding
+
+
+def _installed_plan_defaults(
+    mesh: Mesh | None, axis_name: str | None, batch_spec: Any | None
+) -> tuple[Any, Mesh | None, str | None, Any | None]:
+    """Mirror the loader's default for legacy (no ``parallel=``) step
+    calls: when ``init(parallel=)`` installed a plan and the step rides
+    a mesh carrying its data axes, derive the batch layout / data-axis
+    defaults from the plan so the step and the loader agree on who
+    consumes which batch shard (under a composed dp×fsdp plan the
+    loader shards the batch over BOTH axes). An explicit ``axis_name``
+    or ``batch_spec`` opts the whole call out — the caller chose their
+    own batch layout, so the plan must not supply the OTHER half (or
+    the dp-worker accounting that goes with its wider layout). Callers
+    manage their own state layout (no banked-sharding pull, unlike
+    ``parallel=``)."""
+    from ..runtime import global_plan
+
+    if axis_name is not None or batch_spec is not None:
+        return None, mesh, axis_name, batch_spec
+    plan = global_plan()
+    if plan is None or not plan.covers(mesh):
+        return None, mesh, axis_name, batch_spec
+    return plan, mesh, plan.dp_axis_name, plan.batch_spec
+
+
 def make_train_step(
     loss_fn: Callable[[Any, Any, Any], tuple[jax.Array, Any]],
     optimizer: optax.GradientTransformation,
     *,
+    parallel: Any | None = None,
     mesh: Mesh | None = None,
     axis_name: str | None = None,
     style: str = "auto",
@@ -303,8 +362,17 @@ def make_train_step(
         the per-device shard.
       optimizer: any optax transformation (plain — see ``grad_reduce=None``
         for pre-reducing optimizers like ``DistributedOptimizer``).
-      mesh: defaults to the runtime's global mesh.
-      axis_name: data-parallel axis (default from config).
+      parallel: a :class:`~fluxmpi_tpu.parallel.ParallelConfig` or
+        resolved plan — the step derives ``mesh``, ``axis_name``,
+        ``batch_spec``, and ``state_sharding`` from the ONE plan instead
+        of per-call arguments (explicit arguments still win). A plan
+        that shards parameters (fsdp/tp axes or a rules table) requires
+        :meth:`~fluxmpi_tpu.parallel.plan.ResolvedPlan.shard_state` to
+        have been called first — the banked layout is what the compiled
+        step pins; a dp(/sp)-only plan needs nothing banked.
+        ``style="auto"`` only.
+      mesh: defaults to the plan's mesh, else the runtime's global mesh.
+      axis_name: data-parallel axis (default from the plan, else config).
       style: ``"auto"`` (XLA SPMD partitioner inserts collectives) or
         ``"shard_map"`` (explicit per-device body + psum/pmean).
       grad_reduce: ``"mean"`` | ``"sum"`` | ``None`` (no reduction here).
@@ -395,6 +463,21 @@ def make_train_step(
       communication included; call it in a plain Python loop. With
       ``metrics=`` the same signature, instrumented.
     """
+    plan = None
+    if parallel is not None:
+        if style != "auto":
+            raise ValueError(
+                "parallel= requires style='auto' (the plan's layouts are "
+                "partitioner-driven; shard_map takes explicit axis_name=)"
+            )
+        plan, mesh, axis_name, batch_spec, state_sharding = _plan_defaults(
+            parallel, mesh, axis_name, batch_spec, state_sharding,
+            "make_train_step",
+        )
+    elif style == "auto":
+        plan, mesh, axis_name, batch_spec = _installed_plan_defaults(
+            mesh, axis_name, batch_spec
+        )
     mesh = mesh or global_mesh()
     name = axis_name or config.DP_AXIS_NAME
     if donate is None:
@@ -461,7 +544,19 @@ def make_train_step(
 
     stats_depth = _modelstats.resolve_step_spec(model_stats)
     stats_on = stats_depth is not None
-    dp_workers = int(mesh.shape[name]) if name in mesh.shape else 1
+    if plan is not None:
+        # The plan's data axes (dp × fsdp) all consume distinct batch
+        # shards — that product, not one axis, is the worker count the
+        # noise-scale / examples accounting needs. Sized from the mesh
+        # the step actually compiles against (an explicit mesh= override
+        # may carry the axes at different sizes than the plan's own).
+        dp_workers = int(
+            np.prod(
+                [mesh.shape[a] for a in plan.data_axes if a in mesh.shape]
+            )
+        )
+    else:
+        dp_workers = int(mesh.shape[name]) if name in mesh.shape else 1
     aux_names: tuple[str, ...] = ("loss",)
     if instrument or stats_on:
         aux_names = ("loss", "grad_norm")
@@ -793,6 +888,7 @@ def make_window_program(
 def make_eval_step(
     metric_fn: Callable[[Any, Any, Any], Any],
     *,
+    parallel: Any | None = None,
     mesh: Mesh | None = None,
     axis_name: str | None = None,
     state_sharding: Any | None = None,
@@ -809,11 +905,20 @@ def make_eval_step(
     (the user-land eval loops of the reference's examples get the same
     treatment as training here).
 
-    ``state_sharding`` / ``batch_spec`` mirror :func:`make_train_step` so an
-    FSDP/TP-sharded :class:`TrainState` evaluates in its training layout;
-    ``policy`` casts the params to its compute dtype entering
-    ``metric_fn``, same as training.
+    ``parallel`` / ``state_sharding`` / ``batch_spec`` mirror
+    :func:`make_train_step` so an FSDP/TP-sharded :class:`TrainState`
+    evaluates in its training layout; ``policy`` casts the params to its
+    compute dtype entering ``metric_fn``, same as training.
     """
+    if parallel is not None:
+        _, mesh, axis_name, batch_spec, state_sharding = _plan_defaults(
+            parallel, mesh, axis_name, batch_spec, state_sharding,
+            "make_eval_step",
+        )
+    else:
+        _, mesh, axis_name, batch_spec = _installed_plan_defaults(
+            mesh, axis_name, batch_spec
+        )
     mesh = mesh or global_mesh()
     name = axis_name or config.DP_AXIS_NAME
 

@@ -31,8 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .. import config
 from ._compat import shard_map_unchecked
+from .plan import plan_axis_name
 from .ring import _adapter_dropout, _fold_seed, _local_attend
 
 __all__ = ["ulysses_attention", "make_ulysses_attention", "ulysses_attention_fn"]
@@ -78,7 +78,7 @@ def ulysses_attention(
     Outside a bound axis (e.g. ``module.init``) this degrades to exact
     single-device attention, like the ring.
     """
-    name = axis_name or config.SP_AXIS_NAME
+    name = axis_name or plan_axis_name("sp")
     if window is not None and not causal:
         raise ValueError("window (sliding-window attention) requires causal=True")
     if dropout_rate and not use_flash:
@@ -216,7 +216,7 @@ def make_ulysses_attention(
     from ..runtime import global_mesh
 
     mesh = mesh or global_mesh()
-    sp = axis_name or config.SP_AXIS_NAME
+    sp = axis_name or plan_axis_name("sp")
     dp = batch_axis_name
     spec = P(dp, sp)
     if dropout_rate and not use_flash:

@@ -38,7 +38,7 @@ import jax
 from jax.sharding import Mesh
 
 from . import config
-from .errors import FluxMPINotInitializedError
+from .errors import FluxMPINotInitializedError, TopologyMismatchError
 
 __all__ = [
     "init",
@@ -53,6 +53,7 @@ __all__ = [
     "device_count",
     "local_device_count",
     "global_mesh",
+    "global_plan",
     "dp_axis_name",
     "preemption_requested",
     "request_preemption",
@@ -66,6 +67,7 @@ __all__ = [
 class _RuntimeState:
     initialized: bool = False
     mesh: Mesh | None = None
+    plan: Any = None  # the ResolvedPlan behind init(parallel=), if any
     distributed: bool = False
 
 
@@ -269,6 +271,65 @@ def _configure_compile_cache(spec: Any = None) -> None:
     )
 
 
+def _same_rule_config(a: Any, b: Any) -> bool:
+    """Do two ParallelConfigs declare the same partition-rule behavior?
+    Tables compare by value, callables by identity (== on functions)."""
+    try:
+        same_rules = a.rules is b.rules or a.rules == b.rules
+    except Exception:
+        same_rules = False
+    return (
+        bool(same_rules)
+        and a.strict == b.strict
+        and a.fsdp_min_size == b.fsdp_min_size
+    )
+
+
+def _same_plan(parallel: Any, installed: Any) -> bool:
+    """Is the ``parallel=`` argument of an idempotent ``init`` replay the
+    layout already installed? True for the installed plan itself, its
+    source config, an equivalent re-resolved plan, or a config declaring
+    the same axis sizes/names AND rule behavior (rules/strict/
+    fsdp_min_size — a replay changing the rule table must warn, not
+    silently keep the old one) — replaying the same declaration must
+    stay warning-free."""
+    if installed is None:
+        return False
+    if parallel is installed or parallel is installed.config:
+        return True
+    sizes = getattr(parallel, "sizes", None)
+    names = getattr(parallel, "axis_names", None)
+    if not (isinstance(sizes, dict) and isinstance(names, dict)):
+        return False
+    cfg = installed.config
+    other = getattr(parallel, "config", None)
+    if other is not None:
+        # A re-resolved ResolvedPlan: its sizes/axis_names are the
+        # RESOLVED mesh-axes-only dicts — compare against the installed
+        # plan's resolved layout, not the raw config (whose six-axis,
+        # possibly -1 declaration can never dict-equal it).
+        return (
+            sizes == installed.sizes
+            and names == installed.axis_names
+            and _same_rule_config(other, cfg)
+        )
+    if not _same_rule_config(parallel, cfg):
+        return False
+    if sizes == cfg.sizes and names == cfg.axis_names:
+        return True
+    # Different declaration, possibly the same layout (dp=-1 vs dp=8):
+    # resolve against the installed mesh's devices and compare the
+    # resolved layouts.
+    try:
+        resolved = parallel.resolve(list(installed.mesh.devices.flat))
+    except Exception:
+        return False
+    return (
+        resolved.sizes == installed.sizes
+        and resolved.axis_names == installed.axis_names
+    )
+
+
 def _should_init_distributed() -> bool:
     """Heuristic for joining a multi-host world at ``init()``.
 
@@ -289,6 +350,7 @@ def init(
     *,
     devices: Sequence[jax.Device] | None = None,
     mesh_shape: dict[str, int] | None = None,
+    parallel: Any = None,
     distributed: bool | None = None,
     coordinator_address: str | None = None,
     num_processes: int | None = None,
@@ -324,7 +386,18 @@ def init(
       devices: devices to build the mesh over; defaults to all global devices.
       mesh_shape: ordered ``{axis_name: size}``; one size may be ``-1``
         (inferred). Defaults to a 1-D data-parallel mesh
-        ``{config.DP_AXIS_NAME: ndevices}``.
+        ``{config.DP_AXIS_NAME: ndevices}``. Soft-deprecated in favor of
+        ``parallel=`` (which also derives partition rules, batch specs,
+        and the axis names every parallelism module shares); kept for
+        ad-hoc meshes. Mutually exclusive with ``parallel``.
+      parallel: a :class:`~fluxmpi_tpu.parallel.ParallelConfig` (or an
+        already-resolved plan) — the declarative N-D layout. The global
+        mesh is the plan's mesh, the resolved plan is installed as
+        :func:`global_plan` (consumed by ``make_train_step(parallel=)``,
+        pipeline/ring/ulysses axis-name defaults, checkpoint manifests,
+        and the ``/status`` PARALLEL board). Raises
+        :class:`~fluxmpi_tpu.errors.TopologyMismatchError` when the
+        plan's axes cannot cover the devices.
       distributed: force (or forbid) ``jax.distributed.initialize``; default
         auto-detects a pod slice / explicit coordinator.
       coordinator_address, num_processes, process_id: forwarded to
@@ -452,6 +525,19 @@ def init(
     from . import serving as _serving
 
     if _state.initialized:
+        if parallel is not None and not _same_plan(parallel, _state.plan):
+            # The mesh (and any installed plan) is frozen at first init:
+            # silently returning the OLD layout while the caller asked
+            # for a new one would leave every plan consumer
+            # (make_train_step(parallel=), loader defaults, manifests)
+            # quietly plan-less or stale — be loud about it.
+            warnings.warn(
+                "fluxmpi_tpu is already initialized; init(parallel=) "
+                "cannot rebuild the global mesh on an idempotent replay "
+                "— the existing mesh/plan stays. Call shutdown() first "
+                "to re-init under a different ParallelConfig.",
+                stacklevel=2,
+            )
         _configure_telemetry(telemetry)
         _tracing.configure(trace)
         _watchdog.configure(watchdog)
@@ -491,28 +577,63 @@ def init(
             else:
                 raise
 
-    devs = list(devices) if devices is not None else jax.devices()
-    if mesh_shape is None:
-        mesh_shape = {config.DP_AXIS_NAME: len(devs)}
-    axis_names = tuple(mesh_shape.keys())
-    sizes = list(mesh_shape.values())
-    if sizes.count(-1) > 1:
-        raise ValueError("at most one mesh axis may have inferred size -1")
-    if -1 in sizes:
-        known = int(np.prod([s for s in sizes if s != -1]))
-        if len(devs) % known != 0:
-            raise ValueError(
-                f"cannot infer mesh axis: {len(devs)} devices not divisible "
-                f"by {known}"
-            )
-        sizes[sizes.index(-1)] = len(devs) // known
-    if int(np.prod(sizes)) != len(devs):
+    if parallel is not None and mesh_shape is not None:
         raise ValueError(
-            f"mesh_shape {dict(zip(axis_names, sizes))} does not cover "
-            f"{len(devs)} devices"
+            "pass either parallel= (the declarative plan) or mesh_shape= "
+            "(an ad-hoc mesh), not both"
         )
+    devs = list(devices) if devices is not None else jax.devices()
+    if parallel is not None:
+        from .parallel.plan import ParallelConfig, ResolvedPlan
 
-    mesh = Mesh(np.asarray(devs).reshape(sizes), axis_names)
+        if isinstance(parallel, ResolvedPlan):
+            plan = parallel
+            if devices is not None:
+                plan_devs = {d.id for d in plan.mesh.devices.flat}
+                want = {d.id for d in devs}
+                if plan_devs != want:
+                    raise TopologyMismatchError(
+                        f"init(devices=) names {len(want)} device(s) but "
+                        f"the pre-resolved plan's mesh covers device ids "
+                        f"{sorted(plan_devs)} — resolve the ParallelConfig "
+                        f"against those devices, or pass the config "
+                        f"itself"
+                    )
+        elif isinstance(parallel, ParallelConfig):
+            plan = parallel.resolve(devs)
+        else:
+            raise ValueError(
+                f"parallel= must be a ParallelConfig or ResolvedPlan, "
+                f"got {parallel!r}"
+            )
+        mesh = plan.mesh
+        _state.plan = plan
+        axis_names = tuple(mesh.axis_names)
+        sizes = [int(s) for s in mesh.shape.values()]
+    else:
+        if mesh_shape is None:
+            mesh_shape = {config.DP_AXIS_NAME: len(devs)}
+        axis_names = tuple(mesh_shape.keys())
+        sizes = list(mesh_shape.values())
+        if sizes.count(-1) > 1:
+            raise ValueError(
+                "at most one mesh axis may have inferred size -1"
+            )
+        if -1 in sizes:
+            known = int(np.prod([s for s in sizes if s != -1]))
+            if len(devs) % known != 0:
+                raise ValueError(
+                    f"cannot infer mesh axis: {len(devs)} devices not "
+                    f"divisible by {known}"
+                )
+            sizes[sizes.index(-1)] = len(devs) // known
+        if int(np.prod(sizes)) != len(devs):
+            raise ValueError(
+                f"mesh_shape {dict(zip(axis_names, sizes))} does not cover "
+                f"{len(devs)} devices"
+            )
+        mesh = Mesh(np.asarray(devs).reshape(sizes), axis_names)
+        _state.plan = None
     _state.mesh = mesh
     _state.initialized = True
     _configure_telemetry(telemetry)
@@ -529,6 +650,13 @@ def init(
     _configure_compile_cache(compile_cache)
     _export.configure(export)
     _serving.configure(serving)
+    if _state.plan is not None:
+        # PARALLEL board: the resolved mesh/axis sizes land on /status
+        # and the parallel.* gauges the moment the plan is installed
+        # (rule hit counts follow from plan.shard_state).
+        from .parallel.plan import post_board
+
+        post_board(_state.plan)
 
     if verbose:
         if total_workers() == 1:
@@ -579,6 +707,7 @@ def shutdown() -> None:
     uninstall_preemption_handlers()
     _state.initialized = False
     _state.mesh = None
+    _state.plan = None
 
 
 def _require_init() -> None:
@@ -633,6 +762,18 @@ def global_mesh() -> Mesh:
     return _state.mesh
 
 
+def global_plan() -> Any:
+    """The :class:`~fluxmpi_tpu.parallel.plan.ResolvedPlan` installed by
+    ``init(parallel=)``, or None (uninitialized runtime, or a mesh built
+    from ``mesh_shape=``/defaults). Non-raising on purpose: consumers
+    (pipeline/ring/ulysses axis-name defaults, checkpoint manifests)
+    fall back to the ``*_axis_name`` preferences when no plan exists."""
+    return _state.plan
+
+
 def dp_axis_name() -> str:
-    """Name of the data-parallel mesh axis."""
+    """Name of the data-parallel mesh axis (the installed plan's when
+    ``init(parallel=)`` built the mesh, else the preference)."""
+    if _state.plan is not None:
+        return _state.plan.dp_axis_name
     return config.DP_AXIS_NAME

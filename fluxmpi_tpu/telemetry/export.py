@@ -367,6 +367,7 @@ class Exporter:
         self._status: dict[str, Any] = {}
         self._serving: dict[str, Any] = {}
         self._model: dict[str, Any] = {}
+        self._parallel: dict[str, Any] = {}
         self._status_lock = threading.Lock()
         # Progress plateau tracking (the watchdog's check() shape,
         # evaluated lazily per health request instead of on a poll
@@ -472,11 +473,23 @@ class Exporter:
             self._model.update(fields)
             self._model["noted_unix"] = time.time()
 
+    def note_parallel(self, **fields: Any) -> None:
+        """Merge ``fields`` into the ``parallel`` section of ``/status``
+        — the PARALLEL board (resolved mesh/axis sizes, the plan→mesh
+        axis-name map, per-source partition-rule hit counts), posted by
+        ``init(parallel=)`` when the plan is installed and refreshed by
+        ``ResolvedPlan.shard_state``. ``scripts/fluxmpi_top.py`` renders
+        it as the PARALLEL view."""
+        with self._status_lock:
+            self._parallel.update(fields)
+            self._parallel["noted_unix"] = time.time()
+
     def clear_status(self) -> None:
         with self._status_lock:
             self._status.clear()
             self._serving.clear()
             self._model.clear()
+            self._parallel.clear()
 
     # -- health --------------------------------------------------------
 
@@ -571,6 +584,7 @@ class Exporter:
             train = dict(self._status)
             serving = dict(self._serving) or None
             model = dict(self._model) or None
+            parallel = dict(self._parallel) or None
         gp = _goodput.get_goodput_tracker()
         goodput_rep = gp.report() if gp.enabled else None
         det = _anomaly.get_anomaly_detector()
@@ -602,6 +616,7 @@ class Exporter:
             "train": train,
             "serving": serving,
             "model": model,
+            "parallel": parallel,
             "goodput": goodput_rep,
             "anomaly": last_anomaly,
             "monitor": monitor,

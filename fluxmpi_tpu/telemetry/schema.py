@@ -183,6 +183,13 @@ KNOWN_METRIC_NAMES = frozenset(
         "model.grad_sqnorm_local",
         "model.grad_sqnorm_global",
         "model.grad_noise_scale",
+        # Parallelism plane (parallel/plan.py): the resolved mesh's
+        # per-axis device counts ({axis=...}) and the partition-rule
+        # engine's per-source hit counts ({source=table|tp|fsdp|
+        # replicated}) — posted when init(parallel=) installs a plan
+        # and refreshed by ResolvedPlan.shard_state.
+        "parallel.axis_size",
+        "parallel.rule_hits",
     }
 )
 
@@ -196,6 +203,7 @@ _CLOSED_NAMESPACES = (
     "export.",
     "serving.",
     "model.",
+    "parallel.",
 )
 
 # Histogram bucket edges, declared HERE so the registry (which bins
@@ -277,6 +285,13 @@ _BENCH_OPTIONAL: dict[str, tuple[type, ...]] = {
     # count across mid-flight joins (must be 0 — the zero-retrace
     # claim, asserted by tests/test_bench.py's smoke).
     "serving": (dict,),
+    # ParallelConfig plane (parallel/plan.py): the train_loop child's
+    # resolved plan — axes, rule hit counts, the loop's own
+    # dispatches-per-update under the plan-derived sharding — and the
+    # per-axis composition legs (dp vs dp×fsdp vs dp×tp) on the CPU
+    # virtual mesh.
+    "parallel": (dict,),
+    "parallel_axes": (dict,),
 }
 
 
@@ -451,7 +466,7 @@ def validate_status_record(rec: object) -> list[str]:
     for key in ("train", "monitor", "watchdog"):
         if not isinstance(rec.get(key), dict):
             errors.append(f"'{key}' must be an object")
-    for key in ("goodput", "anomaly", "serving", "model"):
+    for key in ("goodput", "anomaly", "serving", "model", "parallel"):
         v = rec.get(key)
         if v is not None and not isinstance(v, dict):
             errors.append(f"'{key}' must be null or an object")
@@ -606,6 +621,33 @@ def validate_manifest(rec: object) -> list[str]:
             for key in _MANIFEST_COUNTER_KEYS:
                 if not _is_int(counters.get(key)):
                     errors.append(f"counters: missing int {key!r}")
+    parallel = rec.get("parallel")
+    if parallel is not None:
+        # The ParallelConfig that produced the specs (parallel/plan.py):
+        # plan-axis sizes plus the plan-axis → mesh-axis name map, so a
+        # restore can rebuild the SAME composed layout declaratively.
+        if not isinstance(parallel, dict):
+            errors.append(
+                f"'parallel' must be null or an object, got {parallel!r}"
+            )
+        else:
+            axes = parallel.get("axes")
+            if not isinstance(axes, dict) or not axes or not all(
+                isinstance(k, str) and k and _is_int(v) and v >= 1
+                for k, v in axes.items()
+            ):
+                errors.append(
+                    "parallel: 'axes' must map plan axis -> size >= 1"
+                )
+            names = parallel.get("axis_names")
+            if not isinstance(names, dict) or not all(
+                isinstance(k, str) and isinstance(v, str) and v
+                for k, v in names.items()
+            ):
+                errors.append(
+                    "parallel: 'axis_names' must map plan axis -> mesh "
+                    "axis name"
+                )
     return errors
 
 

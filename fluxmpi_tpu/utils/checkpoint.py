@@ -641,6 +641,7 @@ def restore_checkpoint(
     allow_layout_change: bool = False,
     mesh: Any = None,
     rule: Any = None,
+    parallel: Any = None,
     manifest: Any = _MANIFEST_UNREAD,
 ) -> Any:
     """Read the checkpoint at ``path`` and return it synchronized from
@@ -673,6 +674,13 @@ def restore_checkpoint(
     once even inside ``train_loop``'s ``resume`` segment — outermost
     attribution wins).
 
+    ``parallel``: a :class:`~fluxmpi_tpu.parallel.ParallelConfig` (or
+    resolved plan) in place of ``(mesh=, rule=)`` — the restore target
+    is the plan's mesh under the plan's combined partition rule, so the
+    SAME declaration that trains a layout also restores into it
+    (checkpoint manifests record the saving plan in their ``parallel``
+    section). Mutually exclusive with explicit ``mesh=``/``rule=``.
+
     ``manifest``: a caller that already read+validated the topology
     manifest (``CheckpointManager.read_manifest`` / ``train_loop``'s
     resume bring-up) passes it here — including an explicit ``None``
@@ -680,6 +688,16 @@ def restore_checkpoint(
     re-validate the sidecar a second time. Left unset, the manifest is
     read from disk as before.
     """
+    if parallel is not None:
+        if mesh is not None or rule is not None:
+            raise ValueError(
+                "pass either parallel= (the plan supplies mesh AND rule) "
+                "or explicit mesh=/rule=, not both"
+            )
+        from ..parallel.plan import resolve_parallel
+
+        plan = resolve_parallel(parallel)
+        mesh, rule = plan.mesh, plan.rule
     with _goodput_segment("checkpoint_restore"):
         return _restore_checkpoint_body(
             path,
@@ -1060,11 +1078,13 @@ class CheckpointManager:
         allow_layout_change: bool = False,
         mesh: Any = None,
         rule: Any = None,
+        parallel: Any = None,
         manifest: Any = _MANIFEST_UNREAD,
     ) -> tuple[int, Any]:
         """Restore ``step`` (default: latest complete) as
         ``(step, state)``; raises ``FileNotFoundError`` when nothing is
-        restorable. ``allow_layout_change``, ``mesh``, ``rule`` and
+        restorable. ``allow_layout_change``, ``mesh``, ``rule``,
+        ``parallel`` (a ParallelConfig/plan in place of mesh+rule) and
         ``manifest`` (a sidecar the caller already read via
         :meth:`read_manifest` — skips the second read+validate) forward
         to :func:`restore_checkpoint` (elastic cross-family /
@@ -1079,7 +1099,7 @@ class CheckpointManager:
         return step, restore_checkpoint(
             self._step_path(step), like,
             allow_layout_change=allow_layout_change,
-            mesh=mesh, rule=rule, manifest=manifest,
+            mesh=mesh, rule=rule, parallel=parallel, manifest=manifest,
         )
 
     def close(self) -> None:

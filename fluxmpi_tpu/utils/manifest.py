@@ -213,6 +213,26 @@ def build_manifest(
                 "spec": spec,
             }
         )
+    # The ParallelConfig behind the mesh, when init(parallel=) built it:
+    # restore tooling can then rebuild the SAME plan (axis sizes + names)
+    # instead of reverse-engineering it from the mesh axes.
+    parallel = None
+    try:
+        from ..runtime import global_plan
+
+        plan = global_plan()
+        if plan is not None:
+            manifest_mesh_probe = mesh if mesh is not None else _tree_mesh(state)
+            if manifest_mesh_probe is None or mesh_axes(
+                plan.mesh
+            ) == mesh_axes(manifest_mesh_probe):
+                desc = plan.describe()
+                parallel = {
+                    "axes": desc["axes"],
+                    "axis_names": desc["axis_names"],
+                }
+    except Exception:
+        parallel = None
     counters = _int_section(state, "loop")
     loop_keys = ("updates", "examples", "epochs")
     if counters is not None and sorted(counters) != sorted(loop_keys):
@@ -243,6 +263,7 @@ def build_manifest(
         "leaves": leaves,
         "loader": loader,
         "counters": counters,
+        "parallel": parallel,
     }
 
 

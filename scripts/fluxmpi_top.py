@@ -302,6 +302,40 @@ def _model_rows(statuses: dict[str, Any]) -> list[str]:
     return rows
 
 
+def _parallel_rows(statuses: dict[str, Any]) -> list[str]:
+    """The PARALLEL block: one row per host whose ``/status`` carries a
+    ``parallel`` board (``init(parallel=)`` posts it; ``shard_state``
+    refreshes the rule hit counts) — the resolved mesh shape, the
+    effective data-parallel worker count, and how many parameter leaves
+    each rule source (user table, TP table, FSDP fallback, replicated)
+    claimed."""
+    rows: list[str] = []
+    for name, status in statuses.items():
+        board = (status or {}).get("parallel")
+        if not isinstance(board, dict):
+            continue
+        if not rows:
+            rows.append(f"{'PARALLEL':<18}{'DP':>5}  MESH / RULE HITS")
+        mesh = board.get("mesh")
+        mesh_str = "-"
+        if isinstance(mesh, dict) and mesh:
+            mesh_str = "x".join(
+                f"{axis}:{size}" for axis, size in mesh.items()
+            )
+        hits = board.get("rule_hits")
+        hits_str = ""
+        if isinstance(hits, dict) and hits:
+            hits_str = "  " + " ".join(
+                f"{source}={count}" for source, count in sorted(hits.items())
+            )
+        rows.append(
+            f"{name:<18}"
+            f"{_fmt(board.get('data_parallel_size'), '>5.0f'):>5}  "
+            f"{mesh_str}{hits_str}"
+        )
+    return rows
+
+
 def render_frame(
     statuses: dict[str, dict[str, Any] | None],
     rates: dict[str, tuple[float, float]],
@@ -351,6 +385,7 @@ def render_frame(
             )
     lines.append("anomalies:" + (" (none)" if not tickers else ""))
     lines.extend(tickers)
+    lines.extend(_parallel_rows(statuses))
     lines.extend(_model_rows(statuses))
     lines.extend(_serving_rows(statuses, rates))
     return "\n".join(lines)

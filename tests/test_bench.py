@@ -124,8 +124,10 @@ def test_run_scaling_config_selection(monkeypatch):
     calls.clear()
     out = bench._run_scaling(3000.0, None, None)
     assert out["mode"] == "cpu-virtual"
-    assert out["config"] == "mlp"
-    assert [c[0] for c in calls] == ["mlp", "mlp"]
+    # The cpu-virtual legs run the REAL driver (train_loop fuse="window"
+    # under a ParallelConfig), not the synthetic-step mlp child.
+    assert out["config"] == "train_loop"
+    assert [c[0] for c in calls] == ["train_loop", "train_loop"]
 
     # Env override wins.
     monkeypatch.setenv("FLUXMPI_TPU_BENCH_SCALING_CONFIG", "cnn")
@@ -348,6 +350,93 @@ def test_bench_serving_ab_smoke(tmp_path):
     assert check.returncode == 0, check.stdout + check.stderr
 
 
+def test_parse_parallel_env(monkeypatch):
+    monkeypatch.delenv("FLUXMPI_TPU_BENCH_PARALLEL", raising=False)
+    assert bench._parse_parallel_env() == {"dp": -1}
+    monkeypatch.setenv("FLUXMPI_TPU_BENCH_PARALLEL", "dp=4,fsdp=2")
+    assert bench._parse_parallel_env() == {"dp": 4, "fsdp": 2}
+    # Env typos degrade to the default (warn-and-default convention).
+    for bad in ("dp=four", "dp=4,", "dp4"):
+        monkeypatch.setenv("FLUXMPI_TPU_BENCH_PARALLEL", bad)
+        assert bench._parse_parallel_env() == {"dp": -1}
+
+
+def test_run_axis_bench_composes_legs(monkeypatch):
+    calls = []
+
+    def fake_run_child(config, timeout, platform, extra_env=None):
+        calls.append((config, platform, dict(extra_env or {})))
+        return {
+            "metric": "train_loop_tokens_per_sec_per_chip", "value": 50.0,
+            "unit": "tokens/sec/chip", "vs_baseline": 1.0, "n_chips": 8,
+            "parallel": {"axes": {"dp": 4}, "data_parallel_size": 4,
+                         "dispatches_per_update": 0.125,
+                         "sharded_param_leaves": 3, "rule_hits": {}},
+        }
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    out = bench._run_axis_bench(3000.0)
+    assert set(out) == {"dp", "dp_fsdp", "dp_tp"}
+    specs = [c[2]["FLUXMPI_TPU_BENCH_PARALLEL"] for c in calls]
+    assert specs == ["dp=8", "dp=4,fsdp=2", "dp=4,tp=2"]
+    assert all(c[0] == "train_loop" for c in calls)
+    assert all(
+        "--xla_force_host_platform_device_count=8" in c[2]["XLA_FLAGS"]
+        for c in calls
+    )
+    assert out["dp"]["dispatches_per_update"] == 0.125
+    # No budget → no legs, not a crash.
+    assert bench._run_axis_bench(30.0) is None
+
+
+def test_bench_train_loop_dp_fsdp_leg_smoke(tmp_path):
+    """The smoke dp×fsdp composition leg (tier-1): the train_loop child
+    forced through smoke mode under FLUXMPI_TPU_BENCH_PARALLEL=dp=4,fsdp=2
+    — the scaling legs' real-driver contract, asserted in the record:
+    fused windows engaged (dispatches_per_update == 1/window) under the
+    plan-derived sharding (sharded parameter leaves > 0), schema-valid."""
+    import os
+    import subprocess
+    import sys
+
+    here = os.path.dirname(os.path.abspath(bench.__file__))
+    env = {
+        **os.environ,
+        "FLUXMPI_TPU_BENCH_SMOKE": "1",
+        "FLUXMPI_TPU_BENCH_CONFIG": "train_loop",
+        "FLUXMPI_TPU_BENCH_PARALLEL": "dp=4,fsdp=2",
+        "FLUXMPI_TPU_BENCH_STEPS": "16",
+    }
+    proc = subprocess.run(
+        [sys.executable, os.path.join(here, "bench.py")],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=here,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    result = bench._parse_json_line(proc.stdout)
+    assert result is not None, proc.stderr[-2000:]
+    assert result["metric"] == "train_loop_tokens_per_sec_per_chip", result
+    assert result.get("smoke") == 1
+    par = result["parallel"]
+    assert par["axes"] == {"dp": 4, "fsdp": 2}
+    assert par["data_parallel_size"] == 8
+    assert par["sharded_param_leaves"] > 0
+    assert par["dispatches_per_update"] == pytest.approx(
+        1.0 / par["fused_window"]
+    )
+    json_path = tmp_path / "train_loop.json"
+    json_path.write_text(json.dumps(result))
+    check = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(here, "scripts", "check_metrics_schema.py"),
+            str(json_path),
+        ],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert check.returncode == 0, check.stdout + check.stderr
+
+
 @pytest.mark.slow
 def test_bench_smoke_mode_full_with_scaling(tmp_path):
     """Full smoke including the dp1/dpN scaling pair + breakdown."""
@@ -375,3 +464,11 @@ def test_bench_smoke_mode_full_with_scaling(tmp_path):
     assert scaling["breakdown"]["dpN"]["synthetic"] == scaling[
         "per_chip_at_dpN"
     ]
+    # The scaling legs ride the real fused driver now: the train_loop
+    # child's dispatch accounting is in the breakdown.
+    assert scaling["config"] == "train_loop"
+    assert scaling["breakdown"]["dpN"].get("dispatches_per_update") is not None
+    # And the smoke dp×fsdp composition leg banked alongside.
+    axes = result.get("parallel_axes")
+    assert axes and "dp_fsdp" in axes
+    assert axes["dp_fsdp"]["sharded_param_leaves"] > 0

@@ -21,6 +21,7 @@ from fluxmpi_tpu.analysis import (
     lint_source,
 )
 from fluxmpi_tpu.analysis.rules import (
+    HandBuiltMesh,
     SpmdDivergentCollective,
     UndocumentedEnvVar,
     UnguardedHotPathInstrumentation,
@@ -414,7 +415,84 @@ def test_fault_site_rule_demands_test_coverage():
 
 
 # ---------------------------------------------------------------------------
-# Rule 5: undocumented-env-var
+# Rule 5: hand-built-mesh
+# ---------------------------------------------------------------------------
+
+
+def test_hand_built_mesh_flags_mesh_and_axis_literals():
+    src = textwrap.dedent(
+        """
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+        def bad(devs, q):
+            mesh = Mesh(devs, ("dp", "tp"))
+            spec = P("dp", None)
+            composed = P(("dp", "fsdp"))
+            g = jax.lax.psum(q, "tp")
+            h = attend(q, axis_name="sp")
+            return mesh, spec, composed, g, h
+        """
+    )
+    ctx = _ctx(axis_name_literals=frozenset({"dp", "fsdp", "tp", "sp"}))
+    r = lint_source(
+        src, "fluxmpi_tpu/parallel/ring.py", ctx, rules=[HandBuiltMesh()]
+    )
+    keys = _keys(r, "hand-built-mesh")
+    assert "mesh" in keys
+    assert keys.count("axis:dp") == 2
+    assert "axis:fsdp" in keys and "axis:tp" in keys and "axis:sp" in keys
+
+
+def test_hand_built_mesh_quiet_on_plan_runtime_and_constants():
+    ctx = _ctx(axis_name_literals=frozenset({"dp", "tp"}))
+    src = textwrap.dedent(
+        """
+        from jax.sharding import Mesh, PartitionSpec as P
+        def build(devs):
+            return Mesh(devs, ("dp",)), P("dp")
+        """
+    )
+    # The plan engine and the runtime ARE where meshes come from.
+    for path in ("fluxmpi_tpu/parallel/plan.py", "fluxmpi_tpu/runtime.py"):
+        assert not lint_source(src, path, ctx, rules=[HandBuiltMesh()]).findings
+    # Outside fluxmpi_tpu/ (scripts, tests, examples) the rule is silent.
+    assert not lint_source(
+        src, "scripts/demo.py", ctx, rules=[HandBuiltMesh()]
+    ).findings
+    # The canonical spellings don't trip it.
+    good = textwrap.dedent(
+        """
+        from jax.sharding import PartitionSpec as P
+        from fluxmpi_tpu import config
+        from fluxmpi_tpu.parallel.plan import plan_axis_name
+        def fine(q):
+            spec = P(config.DP_AXIS_NAME)
+            name = plan_axis_name("sp")
+            label = {"axis": "dp"}  # a dict literal is not a spec arg
+            return spec, name, label
+        """
+    )
+    r = lint_source(
+        good, "fluxmpi_tpu/parallel/ring.py", ctx, rules=[HandBuiltMesh()]
+    )
+    assert not r.findings
+
+
+def test_hand_built_mesh_clean_on_repo_and_loaded_registry():
+    # The merged tree is clean under the rule, and the axis registry
+    # loads from config.py (single-sourced, no copy to drift).
+    ctx = ProjectContext.load(_REPO)
+    assert {"dp", "fsdp", "tp", "pp", "sp", "ep"} <= set(
+        ctx.axis_name_literals
+    )
+    report = lint_repo(_REPO, ["fluxmpi_tpu"], context=ctx)
+    assert not [
+        f for f in report.findings if f.rule == "hand-built-mesh"
+    ], report.text()
+
+
+# ---------------------------------------------------------------------------
+# Rule 6: undocumented-env-var
 # ---------------------------------------------------------------------------
 
 
