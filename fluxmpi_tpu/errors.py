@@ -44,6 +44,18 @@ class FaultInjectedError(RuntimeError):
         )
 
 
+class RequestRejectedError(RuntimeError):
+    """Raised by :meth:`ServingRequest.result` / ``stream()`` when the
+    serving engine rejected the request — queue full, drain, preemption,
+    or engine shutdown. Carries ``reject_reason`` so callers can branch
+    on the cause (retry a ``queue_full``, resubmit a ``preempted``
+    elsewhere) without string-matching the message."""
+
+    def __init__(self, reject_reason: str | None) -> None:
+        self.reject_reason = reject_reason
+        super().__init__(f"request rejected ({reject_reason})")
+
+
 class TopologyMismatchError(ValueError):
     """Raised when an elastic restore cannot lay a checkpointed leaf out
     over the *current* mesh: a partition axis named by the saved (or

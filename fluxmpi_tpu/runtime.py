@@ -370,6 +370,7 @@ def init(
     compile_cache: Any = None,
     export: Any = None,
     serving: Any = None,
+    request_log: Any = None,
 ) -> Mesh:
     """Bring up the fluxmpi_tpu runtime. Idempotent.
 
@@ -506,6 +507,16 @@ def init(
         otherwise read from ``FLUXMPI_TPU_SERVING`` (+ ``_SLOTS`` /
         ``_BLOCK_SIZE`` / ``_BLOCKS`` / ``_QUEUE``); ``False`` resets
         the plane (any running engine stopped). See docs/serving.md.
+      request_log: install the serving request-observability plane —
+        ``True`` arms it in-memory (per-request lifecycle spans on the
+        trace ring, KV-pool forensics, SLO burn accounting), a path
+        additionally appends one schema'd JSONL record per terminal
+        request there (``{process}`` formatted per host; aggregate with
+        ``scripts/serving_report.py``), or pass a
+        :class:`~fluxmpi_tpu.serving.RequestObserver` for custom SLO
+        thresholds. ``None`` defers to ``FLUXMPI_TPU_REQUEST_LOG``
+        (long burn window from ``FLUXMPI_TPU_SLO_WINDOW``); ``False``
+        resets. See docs/observability.md "Serving plane".
 
     Returns:
       The global :class:`jax.sharding.Mesh`.
@@ -523,6 +534,7 @@ def init(
     from .utils import profiling as _profiling
     from . import faults as _faults_mod
     from . import serving as _serving
+    from .serving import observe as _serving_observe
 
     if _state.initialized:
         if parallel is not None and not _same_plan(parallel, _state.plan):
@@ -552,6 +564,7 @@ def init(
         _configure_compile_cache(compile_cache)
         _export.configure(export)
         _serving.configure(serving)
+        _serving_observe.configure(request_log)
         if verbose:
             fluxmpi_println("fluxmpi_tpu already initialized; skipping...")
         assert _state.mesh is not None
@@ -650,6 +663,7 @@ def init(
     _configure_compile_cache(compile_cache)
     _export.configure(export)
     _serving.configure(serving)
+    _serving_observe.configure(request_log)
     if _state.plan is not None:
         # PARALLEL board: the resolved mesh/axis sizes land on /status
         # and the parallel.* gauges the moment the plan is installed

@@ -35,6 +35,13 @@ from .engine import (  # noqa: F401
     set_engine,
     shutdown,
 )
+from .observe import (  # noqa: F401
+    RequestLog,
+    RequestObserver,
+    SLOBurnTracker,
+    get_request_observer,
+    set_request_observer,
+)
 
 __all__ = [
     "BlockKVCache",
@@ -42,9 +49,14 @@ __all__ = [
     "InferenceEngine",
     "ServingConfig",
     "ServingRequest",
+    "RequestLog",
+    "RequestObserver",
+    "SLOBurnTracker",
     "configure",
     "enabled",
     "get_engine",
+    "get_request_observer",
     "set_engine",
+    "set_request_observer",
     "shutdown",
 ]

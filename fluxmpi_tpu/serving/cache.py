@@ -101,6 +101,9 @@ class BlockKVCache:
         # LIFO free list: the most recently freed block is handed out
         # next — the round-trip the reuse test pins down.
         self._free: list[int] = list(range(self.num_blocks - 1, 0, -1))
+        # Forensics (PR 16): the pool-lifetime peak of used_blocks —
+        # "how close did this run actually get to the wall".
+        self._high_watermark = 0
         self._k_pool = None
         self._v_pool = None
 
@@ -122,6 +125,30 @@ class BlockKVCache:
     @property
     def free_tokens(self) -> int:
         return len(self._free) * self.block_size
+
+    @property
+    def high_watermark_blocks(self) -> int:
+        """Pool-lifetime peak of :attr:`used_blocks` (updated at every
+        allocation) — the occupancy forensics gauge."""
+        return self._high_watermark
+
+    @property
+    def fragmentation(self) -> float:
+        """Free-list scatter in [0, 1]: ``1 - (longest contiguous free
+        run / free blocks)``; 0.0 when the free space is one run (or
+        empty). Block allocation is id-agnostic, so this never blocks
+        an admission — it measures how shuffled churn has left the
+        pool, the precursor signal for block-coalescing / prefix-cache
+        work that DOES care about contiguity."""
+        if not self._free:
+            return 0.0
+        ids = sorted(self._free)
+        longest = run = 1
+        for a, b in zip(ids, ids[1:]):
+            run = run + 1 if b == a + 1 else 1
+            if run > longest:
+                longest = run
+        return 1.0 - longest / len(ids)
 
     def blocks_for(self, tokens: int) -> int:
         return blocks_for_tokens(tokens, self.block_size)
@@ -145,7 +172,10 @@ class BlockKVCache:
                 f"{tokens} tokens need {need} blocks but block tables are "
                 f"{self.max_blocks_per_seq} wide"
             )
-        return [self._free.pop() for _ in range(need)]
+        blocks = [self._free.pop() for _ in range(need)]
+        if self.used_blocks > self._high_watermark:
+            self._high_watermark = self.used_blocks
+        return blocks
 
     def free(self, blocks: list[int]) -> None:
         """Return a sequence's blocks to the pool (eviction)."""

@@ -59,7 +59,9 @@ from typing import Any, Callable
 
 import numpy as np
 
+from ..errors import RequestRejectedError
 from ..telemetry.registry import MetricsRegistry, get_registry
+from . import observe as _observe_mod
 from .cache import BlockKVCache, TRASH_BLOCK, blocks_for_tokens
 
 __all__ = [
@@ -261,6 +263,9 @@ class ServingRequest:
     ):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
+        # Process-unique id: the request-observability plane's track
+        # key (Perfetto lane, JSONL record, census attribution).
+        self.id = _observe_mod.next_request_id()
         self.eos_token = eos_token
         self.on_token = on_token
         self.tokens: list[int] = []
@@ -286,23 +291,24 @@ class ServingRequest:
 
     def result(self, timeout: float | None = None) -> np.ndarray:
         """The full sequence (prompt + generated tokens) once finished;
-        raises ``RuntimeError`` for rejected requests."""
+        raises :class:`~fluxmpi_tpu.errors.RequestRejectedError` (a
+        ``RuntimeError`` carrying ``reject_reason``) for rejected
+        requests."""
         if not self.wait(timeout):
             raise TimeoutError("request still in flight")
         if self.status == REJECTED:
-            raise RuntimeError(
-                f"request rejected ({self.reject_reason})"
-            )
+            raise RequestRejectedError(self.reject_reason)
         return np.concatenate(
             [self.prompt, np.asarray(self.tokens, np.int32)]
         )
 
     def stream(self, timeout: float | None = None):
         """Yield generated tokens as the engine produces them (ends at
-        completion; raises ``RuntimeError`` on rejection and
-        ``TimeoutError`` when ``timeout`` seconds pass without a token
-        — the same exception :meth:`result` uses, not the internal
-        queue's). Drive the engine from another thread
+        completion; raises
+        :class:`~fluxmpi_tpu.errors.RequestRejectedError` on rejection
+        and ``TimeoutError`` when ``timeout`` seconds pass without a
+        token — the same exception :meth:`result` uses, not the
+        internal queue's). Drive the engine from another thread
         (:meth:`InferenceEngine.start`) or interleave with
         :meth:`InferenceEngine.step` calls."""
         while True:
@@ -314,9 +320,7 @@ class ServingRequest:
                 ) from None
             if tok is None:
                 if self.status == REJECTED:
-                    raise RuntimeError(
-                        f"request rejected ({self.reject_reason})"
-                    )
+                    raise RequestRejectedError(self.reject_reason)
                 return
             yield tok
 
@@ -545,6 +549,7 @@ class InferenceEngine:
         # Registry-counter delta baselines (see _resolve_run).
         self._counted_steps = 0
         self._counted_tokens = 0
+        self._counted_records = 0
 
         self._decode_step = self._build_decode_step()
         self._prefill_steps: dict[int, Any] = {}
@@ -796,12 +801,26 @@ class InferenceEngine:
         self._wake.set()
         return req
 
-    def _reject(self, req: ServingRequest, reason: str) -> None:
+    def _reject(
+        self, req: ServingRequest, reason: str, *, kv_blocks: int = 0
+    ) -> None:
         self._rejected += 1
         req._finish(REJECTED, reason)
         reg = self._live_registry()
         if getattr(reg, "enabled", True):
             reg.counter("serving.admission_rejects", reason=reason).inc()
+        # Live lookup (like the registry above, not the per-run
+        # resolution): rejects can happen from submit() before any
+        # run()/start() resolved the plane, and every rejected request
+        # must still land in the log — the drain-completeness contract.
+        obs = _observe_mod.get_request_observer()
+        if obs is not None and obs.enabled:
+            obs.observe_terminal(req, kv_blocks=kv_blocks)
+            if reason == "queue_full":
+                # The load-shed moment is when the pool census matters:
+                # fold it into the OOM-style debug bundle (rate-limited
+                # to the first shed).
+                obs.maybe_write_bundle(self, "queue_full")
 
     def _live_registry(self) -> MetricsRegistry:
         return self._registry if self._registry is not None else get_registry()
@@ -938,9 +957,21 @@ class InferenceEngine:
                 reg.histogram("serving.token_seconds").observe(
                     req.per_token_s
                 )
+            # Request-size mix (token-count ladder, not the latency
+            # ladders): completions only — a rejected request's sizes
+            # live in its JSONL record, not the served-mix histograms.
+            reg.histogram("serving.prompt_tokens").observe(
+                int(req.prompt.shape[0])
+            )
+            reg.histogram("serving.output_tokens").observe(len(req.tokens))
             reg.counter("serving.requests_completed").inc()
             for kind in violations:
                 reg.counter("serving.slo_violations", kind=kind).inc()
+        if self._observer is not None:
+            self._observer.observe_terminal(
+                req, kv_blocks=len(slot.blocks),
+                violations=tuple(violations),
+            )
 
     # -- the loop ------------------------------------------------------
 
@@ -995,12 +1026,19 @@ class InferenceEngine:
     def _observe(self, phase: str) -> None:
         """Refresh the gauges + the exporter status board (resolved once
         per run — never on the fully-off path)."""
+        obs = self._observer
         if self._record:
             reg = self._reg
             reg.gauge("serving.queue_depth").set(self.queue_depth)
             reg.gauge("serving.active_sequences").set(self.active_count)
             reg.gauge("serving.kv_blocks_in_use").set(self.cache.used_blocks)
             reg.gauge("serving.kv_blocks_free").set(self.cache.free_blocks)
+            reg.gauge("serving.kv_high_watermark_blocks").set(
+                self.cache.high_watermark_blocks
+            )
+            reg.gauge("serving.kv_fragmentation").set(
+                self.cache.fragmentation
+            )
             reg.counter("serving.decode_steps").inc(
                 self._decode_steps - self._counted_steps
             )
@@ -1009,9 +1047,29 @@ class InferenceEngine:
             )
             self._counted_steps = self._decode_steps
             self._counted_tokens = self._tokens
+            if obs is not None:
+                for w, rate in obs.burn.burn_rates().items():
+                    reg.gauge(
+                        "serving.slo_burn_rate", window=f"{w:g}"
+                    ).set(rate)
+                reg.counter("serving.requests_logged").inc(
+                    obs.records - self._counted_records
+                )
+                self._counted_records = obs.records
+        if obs is not None:
+            # Feed the anomaly plane the multi-window alert rate (both
+            # windows must be burning) — the `slo_burn` rule owns the
+            # threshold and the warn/halt policy.
+            rate = obs.burn.alert_rate()
+            if rate is not None:
+                from ..telemetry.anomaly import get_anomaly_detector
+
+                det = get_anomaly_detector()
+                if det is not None and det.enabled:
+                    det.observe(slo_burn=rate, step=self._decode_steps)
         if self._exporter is not None:
             total = self.cache.num_blocks - 1
-            self._exporter.note_serving(
+            board: dict[str, Any] = dict(
                 phase=phase,
                 continuous=self.continuous,
                 slots=self.slots,
@@ -1025,8 +1083,13 @@ class InferenceEngine:
                 kv_blocks_in_use=self.cache.used_blocks,
                 kv_blocks_total=total,
                 kv_util=(self.cache.used_blocks / total) if total else 0.0,
+                kv_high_watermark=self.cache.high_watermark_blocks,
+                kv_fragmentation=self.cache.fragmentation,
                 slo_violations=self._slo_violations,
             )
+            if obs is not None:
+                board.update(obs.board())
+            self._exporter.note_serving(**board)
 
     def _resolve_run(self) -> None:
         """The once-per-run resolution of every observability surface
@@ -1037,6 +1100,8 @@ class InferenceEngine:
         self._reg = self._live_registry()
         self._record = bool(getattr(self._reg, "enabled", True))
         self._exporter = get_exporter()
+        obs = _observe_mod.get_request_observer()
+        self._observer = obs if (obs is not None and obs.enabled) else None
         # NOTE: the _counted_* delta baselines are NOT reset here — they
         # live for the engine's lifetime (set once in __init__), so
         # ticks that happened between the last _observe and a driver
@@ -1123,7 +1188,9 @@ class InferenceEngine:
                 if slot is not None:
                     self._slots[i] = None
                     self.cache.free(slot.blocks)
-                    self._reject(slot.req, reason)
+                    self._reject(
+                        slot.req, reason, kv_blocks=len(slot.blocks)
+                    )
 
     def start(self) -> "InferenceEngine":
         """Serve on a background thread until :meth:`stop`: the loop

@@ -297,6 +297,16 @@ def shutdown() -> None:
     except Exception:
         pass
     try:
+        # The request-observability plane rides the serving plane (PR
+        # 16): close the per-request JSONL stream and drop the burn
+        # windows/offender samples BEFORE the trace ring is exported —
+        # observe.shutdown() emits nothing, it only uninstalls.
+        from ..serving import observe as _serving_observe
+
+        _serving_observe.shutdown()
+    except Exception:
+        pass
+    try:
         export.shutdown()
     except Exception:
         pass

@@ -38,6 +38,12 @@ rule                        trigger
                             ``dead_layer_flushes`` consecutive flushes —
                             a frozen / disconnected / saturated layer;
                             the event names the layer
+``slo_burn``                the serving plane's rolling SLO burn rate
+                            (:mod:`~fluxmpi_tpu.serving.observe`'s
+                            multi-window good/total tracker) exceeds
+                            ``slo_burn_threshold`` — the request error
+                            budget is burning faster than it accrues,
+                            the SRE burn-alert condition
 ==========================  ================================================
 
 Each rule carries a **policy**: ``"warn"`` (record and continue),
@@ -107,6 +113,7 @@ RULES = (
     "steady_state_retrace",
     "layer_grad_explosion",
     "dead_layer",
+    "slo_burn",
 )
 
 POLICIES = ("warn", "halt", "off")
@@ -127,6 +134,12 @@ _DEFAULT_POLICIES = {
     # rules stay the halting pair).
     "layer_grad_explosion": "warn",
     "dead_layer": "warn",
+    # Serving request-observability plane (PR 16): a burn rate is a
+    # per-engine (per-host) statistical signal — warn-default like the
+    # other statistical rules; a serving process has no SPMD collective
+    # to desync, but halting an engine on a latency regression would
+    # turn a slow service into a down one.
+    "slo_burn": "warn",
 }
 
 # Rules whose trigger is *performance* evidence an XPlane capture can
@@ -166,6 +179,12 @@ class AnomalyDetector:
         layer; the default tolerates denormal dust).
       dead_layer_flushes: consecutive dead flushes before ``dead_layer``
         fires (once per streak; a recovery re-arms it).
+      slo_burn_threshold: the rolling burn rate (bad requests over the
+        window's error budget, reported by the serving plane's
+        :class:`~fluxmpi_tpu.serving.observe.SLOBurnTracker`) above
+        which ``slo_burn`` fires. 1.0 = the budget is being consumed
+        exactly as fast as it accrues; the default leaves headroom for
+        bursty arrivals the way multi-window SRE burn alerts do.
       dump_dir: where the diagnostics bundle lands (default
         ``FLUXMPI_TPU_ANOMALY_DIR`` or ``.``); stable per-process
         filename, latest trigger wins (the watchdog convention).
@@ -186,6 +205,7 @@ class AnomalyDetector:
         layer_explosion_factor: float = 10.0,
         dead_layer_eps: float = 1e-12,
         dead_layer_flushes: int = 3,
+        slo_burn_threshold: float = 2.0,
         dump_dir: str | None = None,
         dump: bool = True,
     ):
@@ -219,6 +239,7 @@ class AnomalyDetector:
         self.layer_explosion_factor = float(layer_explosion_factor)
         self.dead_layer_eps = float(dead_layer_eps)
         self.dead_layer_flushes = int(dead_layer_flushes)
+        self.slo_burn_threshold = float(slo_burn_threshold)
         self.dump_dir = (
             dump_dir
             if dump_dir is not None
@@ -273,6 +294,7 @@ class AnomalyDetector:
         retraced: str | None = None,
         layer_grad_norms: dict[str, float] | None = None,
         nonfinite_layer: str | None = None,
+        slo_burn: float | None = None,
         step: int | None = None,
     ) -> list[dict[str, Any]]:
         """Evaluate every armed rule against one flush interval's
@@ -293,7 +315,10 @@ class AnomalyDetector:
         view feeding the ``layer_grad_explosion``/``dead_layer`` rules,
         and ``nonfinite_layer`` its NaN provenance — the first layer
         whose gradients went nonfinite, carried on the ``nan_grad`` /
-        ``nan_loss`` events as ``layer``)."""
+        ``nan_loss`` events as ``layer``; ``slo_burn`` is the serving
+        plane's rolling burn rate — the tracker owns the windowing, so
+        the rule has no detector-side warmup and fires whenever the
+        reported rate exceeds ``slo_burn_threshold``)."""
         if not self.enabled:
             return []
         events: list[dict[str, Any]] = []
@@ -435,6 +460,15 @@ class AnomalyDetector:
             if ev:
                 ev["function"] = retraced or UNTRACKED
                 events.append(ev)
+
+        if slo_burn is not None and _finite(float(slo_burn)):
+            # No detector-side warmup: the serving plane's burn tracker
+            # owns the windowing and reports nothing until a window has
+            # data, so a reported rate is already baselined.
+            if float(slo_burn) > self.slo_burn_threshold:
+                ev = self._event("slo_burn", float(slo_burn), step)
+                if ev:
+                    events.append(ev)
 
         for ev in events:
             self._emit(ev)

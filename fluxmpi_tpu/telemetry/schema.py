@@ -63,6 +63,19 @@ MANIFEST_SCHEMA = "fluxmpi_tpu.manifest/v1"
 # and the health verdict. scripts/fluxmpi_top.py polls it fleet-wide.
 STATUS_SCHEMA = "fluxmpi_tpu.status/v1"
 
+# Per-request terminal records from the serving request-observability
+# plane (serving/observe.py): one JSON object per request reaching a
+# terminal state (finished or rejected), appended to the JSONL log that
+# FLUXMPI_TPU_REQUEST_LOG / init(request_log=) opens.
+# scripts/serving_report.py aggregates these into a latency/SLO/reject
+# post-mortem; scripts/check_metrics_schema.py validates each line.
+REQUEST_SCHEMA = "fluxmpi_tpu.request/v1"
+
+# The two terminal statuses a request record may carry — matching the
+# serving engine's FINISHED/REJECTED states. A queued or active request
+# never logs (its record lands when it drains, completes, or rejects).
+REQUEST_STATUSES = ("finished", "rejected")
+
 METRIC_TYPES = ("counter", "gauge", "histogram")
 
 _HIST_STAT_KEYS = ("sum", "min", "max", "mean", "last")
@@ -165,6 +178,24 @@ KNOWN_METRIC_NAMES = frozenset(
         "serving.tokens_generated",
         "serving.kv_blocks_in_use",
         "serving.kv_blocks_free",
+        # Serving request-observability plane (PR 16): per-request size
+        # histograms (token-count ladder, not the latency ladders), the
+        # KV pool's process-lifetime high watermark and free-list
+        # fragmentation gauges, and the rolling SLO burn rate
+        # ({window=<seconds>} — good/total per window, multi-window like
+        # SRE burn alerts) that feeds the `slo_burn` anomaly rule.
+        "serving.prompt_tokens",
+        "serving.output_tokens",
+        "serving.kv_high_watermark_blocks",
+        "serving.kv_fragmentation",
+        "serving.slo_burn_rate",
+        "serving.requests_logged",
+        # Request lifecycle trace instants (serving/observe.py): the
+        # terminal markers on a request's Perfetto track. The span
+        # names (request.queue/prefill/decode) are 'X' events, not
+        # instants, so they need no registration.
+        "request.done",
+        "request.rejected",
         # Model-internals plane (PR 14): per-layer training dynamics
         # computed INSIDE the compiled step (telemetry/modelstats.py) and
         # emitted at train_loop flush boundaries — per-layer gradient /
@@ -224,6 +255,14 @@ _LATENCY_BUCKETS = (
 _FAST_LATENCY_BUCKETS = (1e-05, 2.5e-05, 5e-05, 0.0001, 0.00025) + (
     _LATENCY_BUCKETS
 )
+# Request-size histograms count tokens, not seconds: a powers-of-two
+# ladder from single-token probes up past the longest context anyone
+# serves today, so PromQL can see the prompt/output size mix without a
+# per-deployment edge set.
+_TOKEN_COUNT_BUCKETS = (
+    1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0,
+    1024.0, 2048.0, 4096.0, 8192.0, 16384.0, 32768.0,
+)
 
 HISTOGRAM_BUCKET_EDGES: dict[str, tuple[float, ...]] = {
     "train.step_seconds": _LATENCY_BUCKETS,
@@ -232,6 +271,8 @@ HISTOGRAM_BUCKET_EDGES: dict[str, tuple[float, ...]] = {
     "serving.ttft_seconds": _LATENCY_BUCKETS,
     "serving.token_seconds": _FAST_LATENCY_BUCKETS,
     "serving.queue_wait_seconds": _LATENCY_BUCKETS,
+    "serving.prompt_tokens": _TOKEN_COUNT_BUCKETS,
+    "serving.output_tokens": _TOKEN_COUNT_BUCKETS,
 }
 
 # The preemption trace event train_loop emits when it drains and exits on
@@ -480,6 +521,61 @@ def validate_status_record(rec: object) -> list[str]:
             errors.append("health: missing numeric 'seconds_since_progress'")
         if not _is_number(health.get("deadline_seconds")):
             errors.append("health: missing numeric 'deadline_seconds'")
+    return errors
+
+
+def validate_request_record(rec: object) -> list[str]:
+    """Validate one per-request terminal record (schema
+    "fluxmpi_tpu.request/v1", produced by ``serving/observe.RequestLog``
+    and aggregated by ``scripts/serving_report.py``); returns a list of
+    error strings (empty == valid).
+
+    A record is written exactly once per request, at its terminal
+    transition: ``status`` is "finished" (natural completion) or
+    "rejected" (admission reject, drain, preemption, or engine failure —
+    ``reason`` says which). Latency fields are null when the request
+    never reached the stage that defines them (a queue-rejected request
+    has no TTFT)."""
+    if not isinstance(rec, dict):
+        return [f"request record is not an object: {type(rec).__name__}"]
+    errors: list[str] = []
+    if rec.get("schema") != REQUEST_SCHEMA:
+        errors.append(
+            f"'schema' must be {REQUEST_SCHEMA!r}, got {rec.get('schema')!r}"
+        )
+    if not _is_number(rec.get("time_unix")):
+        errors.append("missing numeric 'time_unix'")
+    proc = rec.get("process")
+    if not isinstance(proc, int) or isinstance(proc, bool) or proc < 0:
+        errors.append("'process' must be an int >= 0")
+    rid = rec.get("request_id")
+    if not isinstance(rid, int) or isinstance(rid, bool) or rid < 0:
+        errors.append("'request_id' must be an int >= 0")
+    status = rec.get("status")
+    if status not in REQUEST_STATUSES:
+        errors.append(
+            f"'status' must be one of {REQUEST_STATUSES}, got {status!r}"
+        )
+    reason = rec.get("reason")
+    if reason is not None and (not isinstance(reason, str) or not reason):
+        errors.append("'reason' must be null or a non-empty str")
+    if status == "rejected" and not (isinstance(reason, str) and reason):
+        errors.append("rejected record needs a non-empty 'reason'")
+    for key in ("prompt_tokens", "output_tokens", "kv_blocks"):
+        v = rec.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errors.append(f"'{key}' must be an int >= 0")
+    for key in ("queue_wait_s", "ttft_s", "per_token_s", "total_s"):
+        v = rec.get(key)
+        if v is not None and (not _is_number(v) or v < 0):
+            errors.append(f"'{key}' must be null or a number >= 0")
+    if not isinstance(rec.get("slo_ok"), bool):
+        errors.append("'slo_ok' must be a bool")
+    viol = rec.get("slo_violations")
+    if not isinstance(viol, list) or not all(
+        isinstance(k, str) and k for k in viol
+    ):
+        errors.append("'slo_violations' must be a list of non-empty str")
     return errors
 
 

@@ -49,6 +49,7 @@ __all__ = [
     "span",
     "instant",
     "add_complete_event",
+    "name_track",
     "trace_enabled",
     "configure",
     "shutdown",
@@ -122,6 +123,10 @@ class Tracer:
         self._events: deque = deque(maxlen=capacity)
         # thread id -> list of live _Span objects (the open-span stack).
         self._open: dict[int, list] = {}
+        # Virtual-track labels (``track=`` events): export renders a
+        # named lane instead of looking the id up as a thread — how the
+        # serving plane gives every request its own Perfetto track.
+        self._track_names: dict[int, str] = {}
         # Wall-clock anchor: export rebases monotonic perf_counter stamps
         # onto unix time so per-host traces align on one timeline.
         self._anchor_unix = time.time()
@@ -142,28 +147,45 @@ class Tracer:
             return _NOOP_SPAN
         return _Span(self, name, args or None)
 
-    def instant(self, name: str, **args: Any) -> None:
-        """Record a zero-duration marker ("i" event)."""
+    def instant(
+        self, name: str, *, track: int | None = None, **args: Any
+    ) -> None:
+        """Record a zero-duration marker ("i" event). ``track`` puts the
+        event on a virtual lane (see :meth:`name_track`) instead of the
+        calling thread's."""
         if not self.enabled:
             return
         self._events.append(
             ("i", name, time.perf_counter_ns(), 0,
-             threading.get_ident(), args or None)
+             int(track) if track is not None else threading.get_ident(),
+             args or None)
         )
 
     def add_complete_event(
-        self, name: str, t0: float, t1: float, **args: Any
+        self, name: str, t0: float, t1: float,
+        *, track: int | None = None, **args: Any
     ) -> None:
         """Record an already-timed interval (``time.perf_counter()``
         seconds, the clock the comm/data instrumentation already reads)
-        as an "X" event — one deque append, no context-manager overhead."""
+        as an "X" event — one deque append, no context-manager overhead.
+        ``track`` puts the span on a virtual lane (see
+        :meth:`name_track`) instead of the calling thread's."""
         if not self.enabled:
             return
         start_ns = int(t0 * 1e9)
         self._events.append(
             ("X", name, start_ns, max(0, int((t1 - t0) * 1e9)),
-             threading.get_ident(), args or None)
+             int(track) if track is not None else threading.get_ident(),
+             args or None)
         )
+
+    def name_track(self, track: int, name: str) -> None:
+        """Label a virtual track (a ``track=`` id that is not a real
+        thread): export emits ``thread_name`` metadata so Perfetto shows
+        the label — e.g. ``request 7`` — instead of a raw id."""
+        if not self.enabled:
+            return
+        self._track_names[int(track)] = str(name)
 
     # -- inspection / export -------------------------------------------
 
@@ -213,13 +235,16 @@ class Tracer:
         for ph, name, start_ns, dur_ns, tid, args in list(self._events):
             if tid not in seen_tids:
                 seen_tids.add(tid)
+                label = self._track_names.get(tid) or thread_names.get(
+                    tid, f"tid {tid}"
+                )
                 events.append(
                     {
                         "name": "thread_name",
                         "ph": "M",
                         "pid": pid,
                         "tid": tid,
-                        "args": {"name": thread_names.get(tid, f"tid {tid}")},
+                        "args": {"name": label},
                     }
                 )
             ev: dict[str, Any] = {
@@ -289,6 +314,10 @@ def instant(name: str, **args: Any) -> None:
 
 def add_complete_event(name: str, t0: float, t1: float, **args: Any) -> None:
     _default.add_complete_event(name, t0, t1, **args)
+
+
+def name_track(track: int, name: str) -> None:
+    _default.name_track(track, name)
 
 
 def configure(spec: Any = None) -> Tracer:
@@ -369,4 +398,5 @@ def reset() -> None:
     _default.enabled = False
     _default.clear()
     _default._open.clear()
+    _default._track_names.clear()
     _export_path = None

@@ -7,7 +7,11 @@ Usage:
     python scripts/check_metrics_schema.py [FILE ...]
 
 - ``*.jsonl`` files: every line must be a valid telemetry flush record
-  (schema "fluxmpi_tpu.telemetry/v1"); a line carrying a ``bench`` key
+  (schema "fluxmpi_tpu.telemetry/v1") — except lines carrying
+  ``"schema": "fluxmpi_tpu.request/v1"`` (the serving plane's
+  per-request terminal records, ``init(request_log=...)`` /
+  ``FLUXMPI_TPU_REQUEST_LOG``), which validate as request records —
+  and a line carrying a ``bench`` key
   must also embed a valid bench record. Metric names in the
   framework-owned ``fault.`` / ``checkpoint.`` / ``goodput.`` /
   ``anomaly.`` / ``compile.`` / ``memory.`` namespaces must come from
@@ -104,6 +108,16 @@ def check_file(path: str, schema) -> list[str]:
                 rec = json.loads(line)
             except json.JSONDecodeError as exc:
                 errors.append(f"{path}:{i}: not JSON: {exc}")
+                continue
+            if (
+                isinstance(rec, dict)
+                and rec.get("schema") == schema.REQUEST_SCHEMA
+            ):
+                # Per-request terminal record (the serving plane's
+                # request log) — a different line schema sharing the
+                # JSONL transport.
+                for e in schema.validate_request_record(rec):
+                    errors.append(f"{path}:{i}: {e}")
                 continue
             for e in schema.validate_record(rec):
                 errors.append(f"{path}:{i}: {e}")

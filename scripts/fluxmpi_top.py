@@ -24,7 +24,10 @@ Hosts running the serving plane (``fluxmpi_tpu.serving``) additionally
 get a SERVING block — active/queued requests, live decode step rate,
 token counter, KV block utilization, completions/rejects, and an
 SLO-violation ticker — rendered from the ``serving`` section of the
-same ``/status`` snapshot.
+same ``/status`` snapshot. With the request-observability plane on
+(``init(request_log=...)``), the ticker adds the live SLO burn rate,
+TTFT p50/p99, the KV high watermark/fragmentation, and the worst
+offenders by TTFT.
 
 Targets are ``host``, ``host:port`` (default port 9307), or full URLs.
 ``--jsonl FILE...`` is the fallback for runs without an exporter: the
@@ -262,6 +265,28 @@ def _serving_rows(
         slo = srv.get("slo_violations")
         if isinstance(slo, (int, float)) and slo > 0:
             tickers.append(f"  {name}: {int(slo)} SLO violation(s)")
+        # Request-observability extras (absent when the host runs
+        # without init(request_log=...) — the board only carries them
+        # when the observer is installed).
+        if srv.get("requests_logged") is not None:
+            burn = srv.get("burn_rate")
+            p50, p99 = srv.get("ttft_p50"), srv.get("ttft_p99")
+            peak = srv.get("kv_high_watermark")
+            frag = srv.get("kv_fragmentation")
+            line = (
+                f"  {name}: burn {_fmt(burn, '.2f')}x  "
+                f"ttft p50 {_fmt(p50, '.3f')}s p99 {_fmt(p99, '.3f')}s  "
+                f"kv peak {_fmt(peak, '.0f')} "
+                f"frag {_fmt(100 * frag if frag is not None else None, '.0f')}%"
+            )
+            offenders = srv.get("top_offenders")
+            if isinstance(offenders, list) and offenders:
+                line += "  worst " + " ".join(
+                    f"#{o.get('request_id')} {_fmt(o.get('ttft_s'), '.3f')}s"
+                    for o in offenders[:3]
+                    if isinstance(o, dict)
+                )
+            tickers.append(line)
     if rows:
         rows.append("slo:" + (" (none)" if not tickers else ""))
         rows.extend(tickers)

@@ -1,0 +1,330 @@
+#!/usr/bin/env python
+"""Per-request latency/SLO/reject post-mortem from serving request logs.
+
+Reads the per-host request-log JSONL a serving run emitted
+(``init(request_log=...)`` / ``FLUXMPI_TPU_REQUEST_LOG`` — one
+``fluxmpi_tpu.request/v1`` line per terminal request), aggregates the
+fleet's request population, and prints the operator view:
+
+    $ python scripts/serving_report.py requests.*.jsonl
+    host 0: 48 request(s)  finished 44  rejected 4  slo_ok 91.7%
+    fleet: 48 request(s) from 1 stream(s)
+      finished 44  rejected 4 (queue_full 3, preempted 1)
+      tokens: prompt 1203  output 982
+      ttft    p50 0.041s  p99 0.512s  (44 samples)
+      ...
+      slo: 91.7% ok  violations: ttft 3, per_token 1
+      worst ttft: #17 0.512s, #9 0.488s, ...
+
+Every aggregate here is a **registry twin**: the same population the
+engine's cumulative instruments count (``_REGISTRY_TWINS`` names the
+pairing), so the log and the live ``/metrics`` endpoint can be
+cross-checked — if ``finished`` here disagrees with
+``serving.requests_completed`` there, records were lost.
+
+Usage:
+    python scripts/serving_report.py FILE [FILE ...] [--json]
+    python scripts/serving_report.py FILE [FILE ...] --watch N
+
+``--json`` prints one machine-readable JSON object; ``--watch N``
+re-renders every N seconds from the growing log (mid-run monitoring —
+missing files / no records yet are waiting states, Ctrl-C exits 0).
+
+Exit codes (one-shot mode): 0 = request records found and reported;
+1 = inputs readable but NO request records anywhere (the plane was
+off); 2 = a file was missing/unreadable. A torn line (a host killed
+mid-write) is skipped with a stderr warning, never fatal.
+
+Stdlib-only, no jax, no package import — runnable anywhere the JSONL
+landed (same contract as scripts/goodput_report.py;
+scripts/check_metrics_schema.py validates the same lines).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any
+
+REQUEST_SCHEMA = "fluxmpi_tpu.request/v1"
+
+# Log-aggregate → the engine's cumulative registry instrument counting
+# the SAME population: the cross-check contract (and the fluxlint
+# consumer-rule anchor — every literal must be schema-known).
+_REGISTRY_TWINS = {
+    "finished": "serving.requests_completed",
+    "rejected": "serving.admission_rejects",
+    "prompt_tokens": "serving.prompt_tokens",
+    "output_tokens": "serving.output_tokens",
+    "ttft": "serving.ttft_seconds",
+    "per_token": "serving.token_seconds",
+    "queue_wait": "serving.queue_wait_seconds",
+}
+
+
+def _read_streams(
+    paths: list[str],
+) -> tuple[dict[tuple[int, int], dict], list[str]]:
+    """All request records across all files, keyed by
+    ``(process, request_id)`` (a re-read in watch mode must not double
+    count). Returns ``(records, errors)`` — errors are fatal (exit 2)."""
+    records: dict[tuple[int, int], dict] = {}
+    errors: list[str] = []
+    for path in paths:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                content = f.read()
+        except OSError as exc:
+            errors.append(f"{path}: {exc}")
+            continue
+        for i, line in enumerate(content.splitlines(), 1):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as exc:
+                # A torn final line is EXPECTED post-mortem (a host
+                # killed mid-write); the complete records around it
+                # still describe the population — warn, never refuse.
+                print(
+                    f"serving_report: skipping {path}:{i}: not JSON: {exc}",
+                    file=sys.stderr,
+                )
+                continue
+            if (
+                not isinstance(rec, dict)
+                or rec.get("schema") != REQUEST_SCHEMA
+            ):
+                continue
+            proc = rec.get("process")
+            proc = proc if isinstance(proc, int) else 0
+            rid = rec.get("request_id")
+            rid = rid if isinstance(rid, int) else len(records)
+            records[(proc, rid)] = rec
+    return records, errors
+
+
+def _percentile(data: list[float], p: float) -> float:
+    """Nearest-rank percentile over a sorted sample."""
+    return data[min(len(data) - 1, int(p * (len(data) - 1) + 0.5))]
+
+
+def _latency_summary(samples: list[float]) -> dict[str, Any] | None:
+    if not samples:
+        return None
+    data = sorted(samples)
+    return {
+        "count": len(data),
+        "p50": _percentile(data, 0.50),
+        "p99": _percentile(data, 0.99),
+        "max": data[-1],
+        "mean": sum(data) / len(data),
+    }
+
+
+def _aggregate(records: dict[tuple[int, int], dict]) -> dict[str, Any]:
+    recs = [records[k] for k in sorted(records)]
+    finished = [r for r in recs if r.get("status") == "finished"]
+    rejected = [r for r in recs if r.get("status") == "rejected"]
+    reject_reasons: dict[str, int] = {}
+    for r in rejected:
+        reason = r.get("reason") or "unknown"
+        reject_reasons[reason] = reject_reasons.get(reason, 0) + 1
+    violations: dict[str, int] = {}
+    for r in recs:
+        for v in r.get("slo_violations") or []:
+            if isinstance(v, str):
+                violations[v] = violations.get(v, 0) + 1
+
+    def numbers(key: str) -> list[float]:
+        return [
+            float(r[key])
+            for r in recs
+            if isinstance(r.get(key), (int, float))
+            and not isinstance(r.get(key), bool)
+        ]
+
+    per_process: dict[int, dict[str, int]] = {}
+    for r in recs:
+        proc = r.get("process") if isinstance(r.get("process"), int) else 0
+        row = per_process.setdefault(
+            proc, {"requests": 0, "finished": 0, "rejected": 0, "slo_ok": 0}
+        )
+        row["requests"] += 1
+        row["finished"] += int(r.get("status") == "finished")
+        row["rejected"] += int(r.get("status") == "rejected")
+        row["slo_ok"] += int(bool(r.get("slo_ok")))
+    worst = sorted(
+        (
+            (float(r["ttft_s"]), r.get("request_id"), r.get("process"))
+            for r in recs
+            if isinstance(r.get("ttft_s"), (int, float))
+            and not isinstance(r.get("ttft_s"), bool)
+        ),
+        reverse=True,
+    )[:5]
+    slo_ok = sum(1 for r in recs if r.get("slo_ok"))
+    return {
+        "requests": len(recs),
+        "stream_count": len({p for p, _ in records}),
+        "finished": len(finished),
+        "rejected": len(rejected),
+        "reject_reasons": reject_reasons,
+        "prompt_tokens": int(sum(numbers("prompt_tokens"))),
+        "output_tokens": int(sum(numbers("output_tokens"))),
+        "ttft": _latency_summary(numbers("ttft_s")),
+        "per_token": _latency_summary(numbers("per_token_s")),
+        "queue_wait": _latency_summary(numbers("queue_wait_s")),
+        "total": _latency_summary(numbers("total_s")),
+        "slo_ok": slo_ok,
+        "slo_ok_fraction": slo_ok / len(recs) if recs else 0.0,
+        "slo_violations": violations,
+        "worst_ttft": [
+            {"request_id": rid, "process": proc, "ttft_s": t}
+            for t, rid, proc in worst
+        ],
+        "per_process": {str(p): per_process[p] for p in sorted(per_process)},
+        "registry_twins": dict(_REGISTRY_TWINS),
+    }
+
+
+def _render(agg: dict[str, Any]) -> None:
+    for proc, row in agg["per_process"].items():
+        pct = 100.0 * row["slo_ok"] / row["requests"] if row["requests"] else 0.0
+        print(
+            f"host {proc}: {row['requests']} request(s)  "
+            f"finished {row['finished']}  rejected {row['rejected']}  "
+            f"slo_ok {pct:.1f}%"
+        )
+    print(
+        f"fleet: {agg['requests']} request(s) from "
+        f"{agg['stream_count']} stream(s)"
+    )
+    rejects = ", ".join(
+        f"{k} {v}"
+        for k, v in sorted(agg["reject_reasons"].items(), key=lambda e: -e[1])
+    )
+    line = f"  finished {agg['finished']}  rejected {agg['rejected']}"
+    if rejects:
+        line += f" ({rejects})"
+    print(line)
+    print(
+        f"  tokens: prompt {agg['prompt_tokens']}  "
+        f"output {agg['output_tokens']}"
+    )
+    for key in ("ttft", "per_token", "queue_wait", "total"):
+        s = agg.get(key)
+        if s is None:
+            continue
+        print(
+            f"  {key:<10} p50 {s['p50']:.4f}s  p99 {s['p99']:.4f}s  "
+            f"max {s['max']:.4f}s  ({s['count']} samples)"
+        )
+    vio = ", ".join(
+        f"{k} {v}"
+        for k, v in sorted(
+            agg["slo_violations"].items(), key=lambda e: -e[1]
+        )
+    )
+    line = f"  slo: {100.0 * agg['slo_ok_fraction']:.1f}% ok"
+    if vio:
+        line += f"  violations: {vio}"
+    print(line)
+    if agg["worst_ttft"]:
+        worst = ", ".join(
+            f"#{w['request_id']} {w['ttft_s']:.4f}s"
+            for w in agg["worst_ttft"]
+        )
+        print(f"  worst ttft: {worst}")
+
+
+def _report_once(files: list[str], as_json: bool) -> int:
+    records, errors = _read_streams(files)
+    for e in errors:
+        print(e, file=sys.stderr)
+    if errors:
+        return 2
+    if not records:
+        print(
+            f"serving_report: no {REQUEST_SCHEMA} records in "
+            f"{len(files)} file(s) — was the run started with "
+            "FLUXMPI_TPU_REQUEST_LOG / init(request_log=...)?",
+            file=sys.stderr,
+        )
+        return 1
+    agg = _aggregate(records)
+    if as_json:
+        print(json.dumps(agg))
+        return 0
+    _render(agg)
+    return 0
+
+
+def _watch(files: list[str], interval: float, as_json: bool, count: int) -> int:
+    """Re-render every ``interval`` seconds from the growing log.
+    Missing files / no records yet are waiting states here, not errors.
+    ``count`` bounds the iterations (0 = until Ctrl-C; tests pass a
+    small count)."""
+    import time
+
+    iterations = 0
+    while True:
+        records, errors = _read_streams(files)
+        if as_json:
+            agg = _aggregate(records) if records else None
+            print(json.dumps({"time": time.time(), "report": agg}), flush=True)
+        else:
+            sys.stdout.write("\x1b[2J\x1b[H")
+            print(
+                f"serving_report --watch  {time.strftime('%H:%M:%S')}  "
+                f"({len(files)} file(s), refresh {interval:g}s)"
+            )
+            for e in errors:
+                print(f"  waiting: {e}", file=sys.stderr)
+            if not records:
+                print("  (no request records yet — waiting for traffic)")
+            else:
+                _render(_aggregate(records))
+            sys.stdout.flush()
+        iterations += 1
+        if count and iterations >= count:
+            return 0
+        try:
+            time.sleep(interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+def main(argv: list[str]) -> int:
+    parser = argparse.ArgumentParser(
+        description="Per-request latency/SLO/reject report from serving "
+        "request logs"
+    )
+    parser.add_argument("files", nargs="+", help="request-log JSONL file(s)")
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    parser.add_argument(
+        "--watch", type=float, default=None, metavar="N",
+        help="re-render every N seconds from the growing log (mid-run "
+        "monitoring; Ctrl-C exits 0)",
+    )
+    parser.add_argument(
+        "--watch-count", type=int, default=0, metavar="K",
+        help="stop after K watch renders (0 = until interrupted; "
+        "scripting/tests)",
+    )
+    args = parser.parse_args(argv)
+    if args.watch is not None:
+        if args.watch <= 0:
+            parser.error("--watch interval must be > 0")
+        return _watch(args.files, args.watch, args.json, args.watch_count)
+    return _report_once(args.files, args.json)
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main(sys.argv[1:]))
+    except KeyboardInterrupt:
+        raise SystemExit(0)

@@ -137,6 +137,26 @@ def test_bucket_suffix_round_trips_every_bucketed_name():
         assert exposed_base_name(mangle_name(name) + "_bucket") == name
 
 
+def test_token_count_buckets_render_for_serving_size_histograms():
+    """The request-size histograms carry the powers-of-2 token-count
+    ladder: observations land in cumulative le= buckets that render,
+    round-trip, and stay inside the closed namespace."""
+    reg = MetricsRegistry()
+    reg.histogram("serving.prompt_tokens").observe(5)
+    reg.histogram("serving.output_tokens").observe(100)
+    text = render_prometheus(reg.snapshot())
+    base = mangle_name("serving.prompt_tokens")
+    assert f'{base}_bucket{{le="4"}} 0' in text
+    assert f'{base}_bucket{{le="8"}} 1' in text
+    assert f'{base}_bucket{{le="+Inf"}} 1' in text
+    out = mangle_name("serving.output_tokens")
+    assert f'{out}_bucket{{le="64"}} 0' in text
+    assert f'{out}_bucket{{le="128"}} 1' in text
+    for name in ("serving.prompt_tokens", "serving.output_tokens"):
+        assert exposed_base_name(mangle_name(name) + "_bucket") == name
+    _assert_closed_namespace_clean(text)
+
+
 # ---------------------------------------------------------------------------
 # Prometheus rendering
 # ---------------------------------------------------------------------------
@@ -589,6 +609,26 @@ def _check_serving():
     assert _arm_serving.engine.closed
 
 
+def _arm_request_log(tmp_path):
+    from fluxmpi_tpu.serving import observe
+
+    obs = observe.configure(str(tmp_path / "requests.{process}.jsonl"))
+    obs.burn.observe(True)
+    obs.log.write({"probe": 1})
+    assert obs.log._file is not None and obs.burn.total == 1
+    _arm_request_log.obs = obs
+
+
+def _check_request_log():
+    from fluxmpi_tpu.serving import observe
+
+    assert observe.get_request_observer() is None
+    obs = _arm_request_log.obs
+    assert not obs.enabled
+    assert obs.log._file is None  # stream closed
+    assert obs.burn.total == 0  # windows cleared
+
+
 _PLANES = [
     ("registry", _arm_registry, _check_registry),
     ("tracer", _arm_tracer, _check_tracer),
@@ -602,6 +642,7 @@ _PLANES = [
     ("profiler", _arm_profiler, _check_profiler),
     ("exporter", _arm_exporter, _check_exporter),
     ("serving", _arm_serving, _check_serving),
+    ("request_log", _arm_request_log, _check_request_log),
 ]
 
 

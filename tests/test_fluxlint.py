@@ -377,6 +377,31 @@ def test_metric_rule_consumer_scan_flags_dead_family_prefix():
     assert _keys(r, "unknown-metric-name") == ["prefix:train.loss."]
 
 
+def test_metric_rule_covers_serving_report_consumer_literals():
+    """scripts/serving_report.py names registry twins for its JSONL
+    aggregates as plain metric literals — the consumer rule must keep
+    them schema-true: the committed file lints clean, a drifted twin
+    fails."""
+    path = os.path.join(_REPO, "scripts", "serving_report.py")
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    target = '"serving.requests_completed"'
+    assert target in src
+    ctx = ProjectContext.load(_REPO)
+    clean = lint_source(
+        src, "scripts/serving_report.py", ctx, rules=[UnknownMetricName()]
+    )
+    assert clean.findings == []
+    bad = lint_source(
+        src.replace(target, '"serving.requests_completedd"'),
+        "scripts/serving_report.py", ctx, rules=[UnknownMetricName()],
+    )
+    keys = _keys(bad, "unknown-metric-name")
+    assert "serving.requests_completedd" in keys
+    (f,) = [x for x in bad.findings if x.key == "serving.requests_completedd"]
+    assert "serving.requests_completed" in f.message  # nearest-known hint
+
+
 # ---------------------------------------------------------------------------
 # Rule 4: unregistered-fault-site
 # ---------------------------------------------------------------------------

@@ -18,12 +18,14 @@ import jax.numpy as jnp
 
 import fluxmpi_tpu as fm
 from fluxmpi_tpu import faults, runtime, serving
-from fluxmpi_tpu.errors import FaultInjectedError
+from fluxmpi_tpu.errors import FaultInjectedError, RequestRejectedError
 from fluxmpi_tpu.models import TransformerLM
 from fluxmpi_tpu.models.generate import generate
 from fluxmpi_tpu.serving import BlockKVCache, InferenceEngine, blocks_for_tokens
+from fluxmpi_tpu.serving import observe
 from fluxmpi_tpu.telemetry import Exporter, export, get_registry
-from fluxmpi_tpu.telemetry import compileplane
+from fluxmpi_tpu.telemetry import compileplane, tracing
+from fluxmpi_tpu.telemetry.anomaly import AnomalyDetector, set_anomaly_detector
 from fluxmpi_tpu.telemetry.schema import (
     KNOWN_METRIC_NAMES,
     validate_metric,
@@ -58,6 +60,7 @@ def engine_factory(model):
     for eng in built:
         eng.close()
     serving.shutdown()
+    observe.shutdown()
     runtime.clear_preemption()
     get_registry().reset()
 
@@ -438,6 +441,7 @@ def test_metrics_schema_valid_and_namespace_closed(model, engine_factory):
 def test_status_board_and_fluxmpi_top_serving_view(model, engine_factory):
     exp = Exporter(0, "127.0.0.1", deadline=3600.0)
     export.configure(exp)
+    observe.configure(True)  # the request plane enriches the board
     try:
         eng = engine_factory()
         rng = np.random.default_rng(8)
@@ -454,6 +458,11 @@ def test_status_board_and_fluxmpi_top_serving_view(model, engine_factory):
         assert srv["completed"] == summary["completed"] == 3
         assert srv["tokens"] == summary["tokens"]
         assert srv["kv_blocks_in_use"] == 0
+        # Request-plane enrichment: burn + TTFT percentiles + the
+        # logged-record count ride the same snapshot.
+        assert srv["requests_logged"] == 3
+        assert srv["burn_rate"] == 0.0  # healthy run burns nothing
+        assert srv["ttft_p50"] is not None and srv["ttft_p99"] is not None
         # The fleet dashboard renders the serving view from the same
         # snapshot (stdlib CLI, --once exit semantics unchanged).
         here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -465,7 +474,9 @@ def test_status_board_and_fluxmpi_top_serving_view(model, engine_factory):
         assert proc.returncode == 0, proc.stderr
         assert "SERVING" in proc.stdout
         assert "finished" in proc.stdout
+        assert "burn" in proc.stdout  # the request-plane ticker line
     finally:
+        observe.shutdown()
         export.shutdown()
 
 
@@ -674,3 +685,375 @@ def test_engine_close_fails_pending_and_drops_pools(model):
     assert eng.cache._k_pool is None and eng.cache._v_pool is None
     assert eng.cache.free_blocks == eng.cache.num_blocks - 1
     assert serving.get_engine() is None
+
+
+# ---------------------------------------------------------------------------
+# Request-observability plane (serving/observe.py)
+# ---------------------------------------------------------------------------
+
+
+def test_kv_high_watermark_and_fragmentation():
+    """The forensics gauges: the watermark is a pool-lifetime peak (it
+    never comes back down), fragmentation measures free-list scatter —
+    1 - longest contiguous free run / free blocks."""
+    cache = BlockKVCache(num_layers=2, num_heads=4, head_dim=8,
+                         num_blocks=9, block_size=8, max_blocks_per_seq=8)
+    assert cache.high_watermark_blocks == 0
+    assert cache.fragmentation == 0.0  # pristine free list is one run
+    a = cache.alloc(24)  # blocks 1,2,3
+    b = cache.alloc(24)  # blocks 4,5,6
+    assert cache.high_watermark_blocks == 6
+    cache.free(a)
+    # The watermark is a peak, not an occupancy gauge.
+    assert cache.used_blocks == 3 and cache.high_watermark_blocks == 6
+    # Free ids {1,2,3,7,8}: longest run 3 of 5 free -> 0.4 scattered.
+    assert cache.fragmentation == pytest.approx(1.0 - 3.0 / 5.0)
+    cache.free(b)
+    assert cache.fragmentation == 0.0  # coalesced back to one run
+    assert cache.high_watermark_blocks == 6
+
+
+def test_slo_burn_tracker_multi_window_math():
+    now = {"t": 0.0}
+    t = observe.SLOBurnTracker(
+        window=120.0, slo_target=0.9, clock=lambda: now["t"]
+    )
+    assert t.windows == (10.0, 120.0)
+    assert t.budget == pytest.approx(0.1)
+    # An idle service burns nothing — and alerts on nothing.
+    assert t.burn_rate() == 0.0
+    assert t.alert_rate() is None
+    for _ in range(8):
+        t.observe(True)
+    for _ in range(2):
+        t.observe(False)
+    # 2 bad of 10 over a 10% budget = burning 2x as fast as it accrues.
+    assert t.burn_rate(10.0) == pytest.approx(2.0)
+    assert t.burn_rate(120.0) == pytest.approx(2.0)
+    assert t.alert_rate() == pytest.approx(2.0)
+    # A recovered service: the short window clears first, and the
+    # multi-window AND (min) stops alerting even while the long window
+    # still remembers the bad minutes.
+    now["t"] = 50.0
+    t.observe(True)
+    assert t.burn_rate(10.0) == 0.0
+    assert t.burn_rate(120.0) == pytest.approx((2.0 / 11.0) / 0.1)
+    assert t.alert_rate() == 0.0
+    t.reset()
+    assert t.total == 0 and t.good == 0
+    assert t.alert_rate() is None
+    with pytest.raises(ValueError, match="window"):
+        observe.SLOBurnTracker(window=0.0)
+    with pytest.raises(ValueError, match="slo_target"):
+        observe.SLOBurnTracker(slo_target=1.0)
+
+
+def test_slo_burn_anomaly_rule():
+    get_registry().reset()
+    det = AnomalyDetector(dump=False)
+    assert det.policies["slo_burn"] == "warn"
+    # Below threshold (default 2.0): quiet.
+    assert det.observe(slo_burn=1.5, step=1) == []
+    with pytest.warns(UserWarning, match="slo_burn"):
+        events = det.observe(slo_burn=2.5, step=2)
+    assert [e["rule"] for e in events] == ["slo_burn"]
+    assert events[0]["action"] == "warn"
+    snap = {
+        (m["name"], tuple(sorted(m["labels"].items()))): m["value"]
+        for m in get_registry().snapshot()
+    }
+    assert snap[("anomaly.triggered", (("rule", "slo_burn"),))] == 1
+    get_registry().reset()
+
+
+def test_request_log_complete_under_sigterm_drain(
+    model, engine_factory, tmp_path
+):
+    """The drain-completeness contract (and the reject live-lookup):
+    every in-flight, queued, AND post-drain request lands in the
+    request log with its terminal status — asserted end-to-end through
+    the schema checker."""
+    path_spec = str(tmp_path / "requests.{process}.jsonl")
+    observe.configure(path_spec)
+    eng = engine_factory(slots=2, max_queue=8)
+    rng = np.random.default_rng(9)
+    a = eng.submit(_prompt(rng, 5), 24)
+    b = eng.submit(_prompt(rng, 7), 24)
+    c = eng.submit(_prompt(rng, 4), 4)  # queued behind the two slots
+    eng.step()  # admit a + b
+    runtime.request_preemption()
+    try:
+        summary = eng.run()
+    finally:
+        runtime.clear_preemption()
+    assert summary["drained"] == 2 and summary["rejected"] == 1
+    late = eng.submit(_prompt(rng, 4), 4)
+    assert late.status == "rejected" and late.reject_reason == "draining"
+    path = path_spec.format(process=0)
+    with open(path, encoding="utf-8") as f:
+        records = {r["request_id"]: r for r in map(json.loads, f)}
+    assert set(records) == {req.id for req in (a, b, c, late)}
+    assert records[a.id]["status"] == "finished"
+    assert records[a.id]["output_tokens"] == 24
+    assert records[b.id]["status"] == "finished"
+    assert records[c.id]["status"] == "rejected"
+    assert records[c.id]["reason"] == "preempted"
+    assert records[late.id]["reason"] == "draining"
+    # Drained completions carry full timings; rejects carry the nulls
+    # the schema allows.
+    assert records[a.id]["ttft_s"] is not None
+    assert records[late.id]["ttft_s"] is None
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(here, "scripts", "check_metrics_schema.py"), path],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_rejected_requests_raise_typed_error(model, engine_factory):
+    """result()/stream() on a rejected request raise
+    RequestRejectedError — a RuntimeError subclass carrying the reason
+    so callers branch without string-matching (the retry/resubmit
+    split)."""
+    eng = engine_factory(slots=1, max_queue=1)
+    rng = np.random.default_rng(0)
+    eng.submit(_prompt(rng, 4), 4)
+    shed = eng.submit(_prompt(rng, 4), 4)
+    assert shed.status == "rejected"
+    with pytest.raises(RequestRejectedError, match="queue_full") as exc_info:
+        shed.result()
+    assert exc_info.value.reject_reason == "queue_full"
+    assert isinstance(exc_info.value, RuntimeError)  # old except clauses hold
+    with pytest.raises(RequestRejectedError, match="queue_full"):
+        list(shed.stream(timeout=1.0))
+    eng.run()
+
+
+def test_request_plane_fully_off_never_touches_observer(
+    model, engine_factory, monkeypatch
+):
+    """The PR 4 zero-cost contract: with the plane off, a full serving
+    run — including a load-shed reject — never calls ANY plane method.
+    Exploding mocks, not timers."""
+    observe.shutdown()
+    assert observe.get_request_observer() is None
+
+    def boom(*a, **k):
+        raise AssertionError("request plane touched while off")
+
+    monkeypatch.setattr(observe.RequestObserver, "observe_terminal", boom)
+    monkeypatch.setattr(observe.RequestObserver, "board", boom)
+    monkeypatch.setattr(observe.RequestObserver, "maybe_write_bundle", boom)
+    monkeypatch.setattr(observe.SLOBurnTracker, "observe", boom)
+    monkeypatch.setattr(observe.RequestLog, "write", boom)
+    eng = engine_factory(slots=1, max_queue=1)
+    rng = np.random.default_rng(2)
+    ok = eng.submit(_prompt(rng, 4), 4)
+    shed = eng.submit(_prompt(rng, 4), 4)  # queue_full reject path
+    eng.run()
+    assert ok.status == "finished" and len(ok.tokens) == 4
+    assert shed.status == "rejected" and shed.reject_reason == "queue_full"
+
+
+def test_request_plane_e2e_trace_log_report(model, engine_factory, tmp_path):
+    """The acceptance loop: one plane-on run yields (a) a Perfetto-valid
+    merged trace with the request span chains on named tracks, (b) a
+    schema-valid request JSONL, and (c) a serving_report aggregation
+    whose totals match the registry counters."""
+    get_registry().reset()
+    log_spec = str(tmp_path / "requests.{process}.jsonl")
+    trace_spec = str(tmp_path / "trace.{process}.json")
+    tracing.configure(trace_spec)
+    obs = observe.configure(log_spec)
+    obs.dump_dir = str(tmp_path)  # the queue_full bundle lands here too
+    try:
+        eng = engine_factory(slots=2, max_queue=2)
+        rng = np.random.default_rng(7)
+        good = [eng.submit(_prompt(rng, 5), 6) for _ in range(2)]
+        shed = [eng.submit(_prompt(rng, 5), 6) for _ in range(3)]
+        summary = eng.run()
+        assert [r.status for r in good] == ["finished", "finished"]
+        assert {r.reject_reason for r in shed} == {"queue_full"}
+        trace_path = tracing.shutdown()
+        assert trace_path is not None
+    finally:
+        tracing.configure(False)
+        tracing.reset()
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    merged = str(tmp_path / "merged.json")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(here, "scripts", "merge_traces.py"),
+         "-o", merged, trace_path],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    log_path = log_spec.format(process=0)
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(here, "scripts", "check_metrics_schema.py"),
+         merged, log_path],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    with open(merged, encoding="utf-8") as f:
+        trace = json.load(f)
+    names = {e.get("name") for e in trace["traceEvents"]}
+    assert {"request.queue", "request.prefill", "request.decode",
+            "request.done", "request.rejected"} <= names
+    # Every request rides its own named virtual track.
+    track_names = {
+        e["args"]["name"]
+        for e in trace["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+    }
+    assert {f"request {r.id}" for r in good} <= track_names
+    # serving_report totals must agree with the registry counters — the
+    # two accounting paths (JSONL records, metric counters) cannot
+    # drift.
+    proc = subprocess.run(
+        [sys.executable, os.path.join(here, "scripts", "serving_report.py"),
+         "--json", log_path],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    snap = {}
+    for m in get_registry().snapshot():
+        if m["type"] == "counter":
+            snap.setdefault(m["name"], 0)
+            snap[m["name"]] += m["value"]
+    assert report["requests"] == 5
+    assert report["finished"] == snap["serving.requests_completed"] == 2
+    assert report["rejected"] == snap["serving.admission_rejects"] == 3
+    assert report["reject_reasons"] == {"queue_full": 3}
+    assert report["output_tokens"] == summary["tokens"]
+    assert report["ttft"]["count"] == 2
+    assert report["slo_ok"] == 2
+
+
+def test_slo_burn_anomaly_fires_on_regression_silent_when_healthy(
+    model, engine_factory
+):
+    """The burn alert end-to-end: an injected latency regression (an
+    SLO floor no real request can meet) trips the slo_burn rule through
+    the engine's flush; a healthy run with the same wiring stays
+    silent."""
+    get_registry().reset()
+    set_anomaly_detector(AnomalyDetector(dump=False))
+    observe.configure(True)
+    try:
+        eng = engine_factory(slo_ttft_s=1e-9)  # every completion violates
+        rng = np.random.default_rng(4)
+        for _ in range(3):
+            eng.submit(_prompt(rng, 4), 4)
+        with pytest.warns(UserWarning, match="slo_burn"):
+            eng.run()
+        snap = {
+            (m["name"], tuple(sorted(m["labels"].items()))): m["value"]
+            for m in get_registry().snapshot()
+            if m["type"] == "counter"
+        }
+        assert snap[("anomaly.triggered", (("rule", "slo_burn"),))] >= 1
+        # Healthy service, same wiring: silent.
+        observe.shutdown()
+        observe.configure(True)
+        set_anomaly_detector(AnomalyDetector(dump=False))
+        get_registry().reset()
+        eng2 = engine_factory()
+        for _ in range(3):
+            eng2.submit(_prompt(rng, 4), 4)
+        eng2.run()
+        assert not any(
+            m["name"] == "anomaly.triggered"
+            for m in get_registry().snapshot()
+        )
+    finally:
+        set_anomaly_detector(None)
+        observe.shutdown()
+
+
+def test_queue_full_load_shed_writes_debug_bundle_once(
+    model, engine_factory, tmp_path
+):
+    """The first load-shed writes the OOM-style pool-census bundle (who
+    ate the KV pool, at the moment it mattered); later sheds do not
+    rewrite it — forensics are rate-limited to the triggering event."""
+    obs = observe.configure(True)
+    obs.dump_dir = str(tmp_path)
+    eng = engine_factory(slots=1, max_queue=1)
+    rng = np.random.default_rng(6)
+    held = eng.submit(_prompt(rng, 5), 24)
+    eng.step()  # admit: the slot now holds blocks the census reports
+    eng.submit(_prompt(rng, 4), 4)  # fills the queue
+    shed = eng.submit(_prompt(rng, 4), 4)
+    assert shed.reject_reason == "queue_full"
+    bundle_path = os.path.join(str(tmp_path), "fluxmpi_serving.0.json")
+    assert obs.last_dump_path == bundle_path
+    with open(bundle_path, encoding="utf-8") as f:
+        bundle = json.load(f)
+    srv = bundle["serving"]
+    assert srv["blocks_total"] == eng.cache.num_blocks - 1
+    assert srv["blocks_in_use"] > 0
+    assert srv["census"][0]["request_id"] == held.id
+    assert srv["census"][0]["blocks"] == len(eng._slots[0].blocks)
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(here, "scripts", "check_metrics_schema.py"),
+         bundle_path],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # Rate-limited: a second shed does NOT rewrite the bundle.
+    os.unlink(bundle_path)
+    again = eng.submit(_prompt(rng, 4), 4)
+    assert again.reject_reason == "queue_full"
+    assert not os.path.exists(bundle_path)
+    eng.run()
+
+
+def test_request_log_configure_env_forms_and_typo(monkeypatch, tmp_path):
+    observe.shutdown()
+    monkeypatch.delenv("FLUXMPI_TPU_REQUEST_LOG", raising=False)
+    # Unset env: configure(None) is a no-op.
+    assert observe.configure() is None
+    # "1": plane on without a file log (spans/burn/forensics only).
+    obs = observe.configure(True)
+    assert obs is not None and obs.log is None
+    assert observe.configure("1") is obs  # idempotent replay reuses
+    # A path spec installs a log; an equivalent replay keeps the
+    # observer (and its burn windows).
+    spec = str(tmp_path / "requests.{process}.jsonl")
+    obs2 = observe.configure(spec)
+    assert obs2 is not obs and obs2.log.path == spec.format(process=0)
+    assert observe.configure(spec) is obs2
+    # The env spelling of a malformed path warns and degrades...
+    observe.shutdown()
+    monkeypatch.setenv("FLUXMPI_TPU_REQUEST_LOG", "req.{proc}.jsonl")
+    with pytest.warns(UserWarning, match="FLUXMPI_TPU_REQUEST_LOG"):
+        assert observe.configure() is None
+    # ...the programmatic spelling raises (a code bug, not a typo).
+    with pytest.raises(ValueError, match="not formattable"):
+        observe.configure("req.{proc}.jsonl")
+    with pytest.raises(ValueError, match="request_log spec"):
+        observe.configure(3.5)
+    monkeypatch.delenv("FLUXMPI_TPU_REQUEST_LOG")
+    observe.configure(True)
+    assert observe.configure(False) is None
+    assert observe.get_request_observer() is None
+    # The burn-window env var follows the same warn-and-degrade rule.
+    monkeypatch.setenv("FLUXMPI_TPU_SLO_WINDOW", "soon")
+    with pytest.warns(UserWarning, match="FLUXMPI_TPU_SLO_WINDOW"):
+        t = observe.SLOBurnTracker()
+    assert t.windows[-1] == 300.0  # the built-in default held
+
+
+def test_init_request_log_kwarg(world, tmp_path):
+    spec = str(tmp_path / "requests.{process}.jsonl")
+    fm.init(request_log=spec)
+    obs = observe.get_request_observer()
+    assert obs is not None and obs.log.path_spec == spec
+    fm.init(request_log=False)
+    assert observe.get_request_observer() is None
