@@ -493,6 +493,7 @@ def train_loop(
     from ..telemetry import anomaly as _anomaly
     from ..telemetry import compileplane as _compileplane
     from ..telemetry import export as _export
+    from ..telemetry import fleet as _fleet
     from ..telemetry import goodput as _goodput
     from ..telemetry import modelstats as _modelstats
     from .train import _DEFAULT_REGISTRY
@@ -534,6 +535,13 @@ def train_loop(
         and ms_aux is not None
         and "model_stats" in ms_aux
     )
+    # Fleet plane: when armed (init(fleet=)/FLUXMPI_TPU_FLEET — SPMD-
+    # consistent like the others), each flush posts this host's
+    # cumulative attribution ingredients to its own /status board for
+    # the cross-host collector to scrape. Rides the exporter (no
+    # exporter, nothing to scrape), costs one dict merge per flush,
+    # nothing per step; fully off it is one module attribute read here.
+    fl_on = exp_on and _fleet.enabled()
     if cp_on:
         # Tag the hot step for retrace attribution: its jit-cache growth
         # after the warmup boundary names it in the steady_state_retrace
@@ -1118,6 +1126,40 @@ def train_loop(
                 ),
                 dispatches=dispatches,
             )
+            if fl_on:
+                # The FLEET board: cumulative attribution ingredients
+                # the cross-host collector deltas per scrape interval
+                # to name the straggler and its cause — goodput
+                # buckets when that plane is on (data stall vs compute
+                # vs idle), the comm layer's cumulative collective
+                # block time (comm_wait), and the flight-recorder
+                # launch sequence (frozen while peers advance =
+                # desync). All cumulative: the collector owns the
+                # windowing, so scrape and flush cadences need not
+                # align.
+                from ..telemetry.flight_recorder import (
+                    get_flight_recorder,
+                )
+
+                fr = get_flight_recorder()
+                comm_total = 0.0
+                for m in get_registry().snapshot():
+                    if m.get("name") == "comm.block_seconds":
+                        comm_total += float(m.get("sum", 0.0))
+                fleet_fields: dict[str, Any] = {
+                    "updates": updates,
+                    "flight_seq": float(fr.sequence),
+                    "flight_completed": float(fr.completed_count),
+                    "comm_block_seconds": comm_total,
+                }
+                if gp_on:
+                    rep = gp.report()
+                    fleet_fields["wall_seconds"] = rep["wall_seconds"]
+                    for bucket in ("step", "data_stall", "host_idle"):
+                        fleet_fields[f"{bucket}_seconds"] = rep[
+                            "buckets"
+                        ].get(bucket, 0.0)
+                exporter.note_fleet(**fleet_fields)
             if msum is not None:
                 # The MODEL board: noise scale, top-k layers by grad
                 # norm, and NaN provenance — what fluxmpi_top renders.

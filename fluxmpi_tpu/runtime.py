@@ -371,6 +371,7 @@ def init(
     export: Any = None,
     serving: Any = None,
     request_log: Any = None,
+    fleet: Any = None,
 ) -> Mesh:
     """Bring up the fluxmpi_tpu runtime. Idempotent.
 
@@ -517,6 +518,19 @@ def init(
         thresholds. ``None`` defers to ``FLUXMPI_TPU_REQUEST_LOG``
         (long burn window from ``FLUXMPI_TPU_SLO_WINDOW``); ``False``
         resets. See docs/observability.md "Serving plane".
+      fleet: install the fleet plane — ``True`` arms the per-host skew
+        ingredients (the monitor's gather grows the collective-block /
+        flight-sequence columns, train_loop posts the FLEET board) and,
+        on process 0, starts the cross-host
+        :class:`~fluxmpi_tpu.telemetry.FleetCollector` scraping every
+        armed host's ``/status``; a path string additionally appends
+        one ``fluxmpi_tpu.fleet/v1`` snapshot per collect there (read
+        back with ``scripts/fleet_report.py``), or pass a
+        :class:`~fluxmpi_tpu.telemetry.FleetCollector` for custom
+        hosts / interval / thresholds. ``None`` defers to
+        ``FLUXMPI_TPU_FLEET`` (+ ``_FLEET_HOSTS`` / ``_FLEET_INTERVAL``);
+        ``False`` resets (collector stopped). See docs/observability.md
+        "Fleet plane".
 
     Returns:
       The global :class:`jax.sharding.Mesh`.
@@ -526,6 +540,7 @@ def init(
     from .telemetry import compileplane as _compileplane
     from .telemetry import configure as _configure_telemetry
     from .telemetry import export as _export
+    from .telemetry import fleet as _fleet
     from .telemetry import goodput as _goodput
     from .telemetry import memory as _memory
     from .telemetry import modelstats as _modelstats
@@ -565,6 +580,7 @@ def init(
         _export.configure(export)
         _serving.configure(serving)
         _serving_observe.configure(request_log)
+        _fleet.configure(fleet)
         if verbose:
             fluxmpi_println("fluxmpi_tpu already initialized; skipping...")
         assert _state.mesh is not None
@@ -664,6 +680,10 @@ def init(
     _export.configure(export)
     _serving.configure(serving)
     _serving_observe.configure(request_log)
+    # After export.configure: the collector's default scrape target is
+    # this host's own live exporter when FLUXMPI_TPU_FLEET_HOSTS is
+    # unset, so the exporter must already be resolved.
+    _fleet.configure(fleet)
     if _state.plan is not None:
         # PARALLEL board: the resolved mesh/axis sizes land on /status
         # and the parallel.* gauges the moment the plan is installed

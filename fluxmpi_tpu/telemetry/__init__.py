@@ -159,6 +159,12 @@ from .export import (  # noqa: F401
     get_exporter,
     set_exporter,
 )
+from . import fleet  # noqa: F401
+from .fleet import (  # noqa: F401
+    FleetCollector,
+    get_fleet_collector,
+    set_fleet_collector,
+)
 
 __all__ = [
     "Counter",
@@ -211,6 +217,9 @@ __all__ = [
     "Exporter",
     "get_exporter",
     "set_exporter",
+    "FleetCollector",
+    "get_fleet_collector",
+    "set_fleet_collector",
     "configure",
     "shutdown",
 ]
@@ -304,6 +313,13 @@ def shutdown() -> None:
         from ..serving import observe as _serving_observe
 
         _serving_observe.shutdown()
+    except Exception:
+        pass
+    try:
+        # BEFORE the exporter: the collector's polling thread scrapes
+        # exporters — stop the consumer before its sources vanish (and
+        # drop the straggler streak, the fault-plane leak rule).
+        fleet.shutdown()
     except Exception:
         pass
     try:

@@ -44,6 +44,15 @@ rule                        trigger
                             ``slo_burn_threshold`` — the request error
                             budget is burning faster than it accrues,
                             the SRE burn-alert condition
+``persistent_straggler``    the fleet plane's attribution engine
+                            (:mod:`~fluxmpi_tpu.telemetry.fleet`) blamed
+                            the SAME host for
+                            ``persistent_straggler_intervals`` consecutive
+                            collection intervals — not a one-interval
+                            blip but a host that is reliably slowing the
+                            fleet; the event names the host (fires once
+                            per streak via :meth:`observe_straggler`; a
+                            clean interval or a blame hand-off re-arms)
 ==========================  ================================================
 
 Each rule carries a **policy**: ``"warn"`` (record and continue),
@@ -114,6 +123,7 @@ RULES = (
     "layer_grad_explosion",
     "dead_layer",
     "slo_burn",
+    "persistent_straggler",
 )
 
 POLICIES = ("warn", "halt", "off")
@@ -140,6 +150,12 @@ _DEFAULT_POLICIES = {
     # to desync, but halting an engine on a latency regression would
     # turn a slow service into a down one.
     "slo_burn": "warn",
+    # Fleet plane (PR 17): a cross-host statistical verdict computed by
+    # the collector, a process OUTSIDE the SPMD world — halting from
+    # there could never be collective-consistent, and the right response
+    # to a persistently slow host is operator action (drain/replace),
+    # not killing the whole run.
+    "persistent_straggler": "warn",
 }
 
 # Rules whose trigger is *performance* evidence an XPlane capture can
@@ -185,6 +201,11 @@ class AnomalyDetector:
         which ``slo_burn`` fires. 1.0 = the budget is being consumed
         exactly as fast as it accrues; the default leaves headroom for
         bursty arrivals the way multi-window SRE burn alerts do.
+      persistent_straggler_intervals: consecutive collection intervals
+        the fleet plane must blame the SAME host before
+        ``persistent_straggler`` fires (once per streak; a clean
+        interval or a blame hand-off re-arms — see
+        :meth:`observe_straggler`).
       dump_dir: where the diagnostics bundle lands (default
         ``FLUXMPI_TPU_ANOMALY_DIR`` or ``.``); stable per-process
         filename, latest trigger wins (the watchdog convention).
@@ -206,6 +227,7 @@ class AnomalyDetector:
         dead_layer_eps: float = 1e-12,
         dead_layer_flushes: int = 3,
         slo_burn_threshold: float = 2.0,
+        persistent_straggler_intervals: int = 3,
         dump_dir: str | None = None,
         dump: bool = True,
     ):
@@ -240,6 +262,14 @@ class AnomalyDetector:
         self.dead_layer_eps = float(dead_layer_eps)
         self.dead_layer_flushes = int(dead_layer_flushes)
         self.slo_burn_threshold = float(slo_burn_threshold)
+        if persistent_straggler_intervals < 1:
+            raise ValueError(
+                "persistent_straggler_intervals must be >= 1, got "
+                f"{persistent_straggler_intervals}"
+            )
+        self.persistent_straggler_intervals = int(
+            persistent_straggler_intervals
+        )
         self.dump_dir = (
             dump_dir
             if dump_dir is not None
@@ -260,6 +290,11 @@ class AnomalyDetector:
         self._layer_mean: dict[str, float] = {}
         self._layer_n: dict[str, int] = {}
         self._dead_streak: dict[str, int] = {}
+        # Fleet-plane straggler streak (observe_straggler): the host
+        # currently blamed and how many consecutive intervals it has
+        # held the blame.
+        self._straggler_host: str | None = None
+        self._straggler_streak = 0
 
     # -- rule engine ---------------------------------------------------
 
@@ -474,6 +509,48 @@ class AnomalyDetector:
             self._emit(ev)
         return events
 
+    def observe_straggler(
+        self, host: str | None, *, step: int | None = None
+    ) -> list[dict[str, Any]]:
+        """Feed one fleet-plane attribution interval's verdict: the
+        blamed host's name, or None for a clean interval (evaluated but
+        nobody flagged). Kept separate from :meth:`observe` because the
+        caller is the :class:`~fluxmpi_tpu.telemetry.fleet.FleetCollector`
+        on its own thread cadence, not ``train_loop``'s flush path — and
+        because None must mean "explicitly clean" (streak reset) here,
+        where an absent :meth:`observe` input means "no information".
+
+        The ``dead_layer`` streak discipline: ``persistent_straggler``
+        fires exactly once when the same host has been blamed for
+        ``persistent_straggler_intervals`` consecutive intervals (== not
+        >=, so a host that stays slow does not re-trigger every
+        interval); a clean interval resets the streak, a different host
+        starts its own streak at 1. The event names the host."""
+        if not self.enabled:
+            return []
+        events: list[dict[str, Any]] = []
+        if host is None:
+            self._straggler_host = None
+            self._straggler_streak = 0
+        else:
+            if host == self._straggler_host:
+                self._straggler_streak += 1
+            else:
+                self._straggler_host = host
+                self._straggler_streak = 1
+            if self._straggler_streak == self.persistent_straggler_intervals:
+                ev = self._event(
+                    "persistent_straggler",
+                    float(self._straggler_streak),
+                    step,
+                )
+                if ev:
+                    ev["host"] = host
+                    events.append(ev)
+        for ev in events:
+            self._emit(ev)
+        return events
+
     # -- emission ------------------------------------------------------
 
     def _emit(self, ev: dict[str, Any]) -> None:
@@ -484,7 +561,7 @@ class AnomalyDetector:
         from . import tracing as _tracing
 
         extra: dict[str, Any] = {}
-        for key in ("function", "layer"):
+        for key in ("function", "layer", "host"):
             if key in ev:
                 extra[key] = ev[key]
         _tracing.instant(
@@ -501,6 +578,7 @@ class AnomalyDetector:
             f"step {ev['step']})"
             + (f" in {ev['function']}" if "function" in ev else "")
             + (f" in layer {ev['layer']}" if "layer" in ev else "")
+            + (f" on host {ev['host']}" if "host" in ev else "")
             + f" — policy {ev['action']!r}"
             + (
                 f"; diagnostics bundle at {self.dump_path()}"

@@ -368,6 +368,7 @@ class Exporter:
         self._serving: dict[str, Any] = {}
         self._model: dict[str, Any] = {}
         self._parallel: dict[str, Any] = {}
+        self._fleet: dict[str, Any] = {}
         self._status_lock = threading.Lock()
         # Progress plateau tracking (the watchdog's check() shape,
         # evaluated lazily per health request instead of on a poll
@@ -484,12 +485,29 @@ class Exporter:
             self._parallel.update(fields)
             self._parallel["noted_unix"] = time.time()
 
+    def note_fleet(self, **fields: Any) -> None:
+        """Merge ``fields`` into the ``fleet`` section of ``/status`` —
+        this host's cross-host attribution ingredients (cumulative
+        goodput bucket seconds, collective block time, the
+        flight-recorder launch/complete sequence, the update counter),
+        posted by ``train_loop`` at flush boundaries when the
+        :mod:`~fluxmpi_tpu.telemetry.fleet` plane is on. The
+        :class:`~fluxmpi_tpu.telemetry.fleet.FleetCollector` scrapes
+        this section from every host and joins the rows into the
+        straggler attribution; the collector posts its own verdict back
+        here too, so ``scripts/fluxmpi_top.py`` renders the FLEET board
+        from the same endpoint."""
+        with self._status_lock:
+            self._fleet.update(fields)
+            self._fleet["noted_unix"] = time.time()
+
     def clear_status(self) -> None:
         with self._status_lock:
             self._status.clear()
             self._serving.clear()
             self._model.clear()
             self._parallel.clear()
+            self._fleet.clear()
 
     # -- health --------------------------------------------------------
 
@@ -585,6 +603,7 @@ class Exporter:
             serving = dict(self._serving) or None
             model = dict(self._model) or None
             parallel = dict(self._parallel) or None
+            fleet = dict(self._fleet) or None
         gp = _goodput.get_goodput_tracker()
         goodput_rep = gp.report() if gp.enabled else None
         det = _anomaly.get_anomaly_detector()
@@ -617,6 +636,7 @@ class Exporter:
             "serving": serving,
             "model": model,
             "parallel": parallel,
+            "fleet": fleet,
             "goodput": goodput_rep,
             "anomaly": last_anomaly,
             "monitor": monitor,

@@ -76,6 +76,23 @@ REQUEST_SCHEMA = "fluxmpi_tpu.request/v1"
 # never logs (its record lands when it drains, completes, or rejects).
 REQUEST_STATUSES = ("finished", "rejected")
 
+# Fleet-plane snapshots from the cross-host collector
+# (telemetry/fleet.py): one JSON object per collection interval — the
+# per-host health/staleness census joined with the straggler
+# attribution verdict. ``FleetCollector.snapshot()`` returns one;
+# ``FLUXMPI_TPU_FLEET=<path>`` appends one per interval to a JSONL
+# bank that ``scripts/fleet_report.py`` replays post-mortem and
+# ``scripts/check_metrics_schema.py`` validates.
+FLEET_SCHEMA = "fluxmpi_tpu.fleet/v1"
+
+# The causes the straggler attribution engine can assign, in the order
+# it checks them: cross-host flight-recorder sequence divergence
+# (``desync``, via flight_recorder.diff_dumps), then the straggler's
+# dominant badput bucket over the interval (``data_stall`` when input
+# starvation dominates, ``comm_wait`` when collective blocking does),
+# else ``compute`` (the step itself is slow).
+STRAGGLER_CAUSES = ("desync", "data_stall", "comm_wait", "compute")
+
 METRIC_TYPES = ("counter", "gauge", "histogram")
 
 _HIST_STAT_KEYS = ("sum", "min", "max", "mean", "last")
@@ -221,6 +238,22 @@ KNOWN_METRIC_NAMES = frozenset(
         # and refreshed by ResolvedPlan.shard_state.
         "parallel.axis_size",
         "parallel.rule_hits",
+        # Fleet plane (PR 17): the cross-host collector's own metrics —
+        # host census gauges, scrape latency (fast-path ladder so
+        # histogram_quantile sees collector overhead), the per-interval
+        # straggler verdict counter ({cause=...}, STRAGGLER_CAUSES) —
+        # plus the per-flush skew gauges every host computes locally
+        # from the monitor's single host_allgather: worst/mean step-time
+        # ratio and the cross-host spread of cumulative collective
+        # block time (max − min seconds, the "who waits on whom" scalar)
+        # and flight-recorder sequence lag (max − min launched seq).
+        "fleet.hosts",
+        "fleet.hosts_stale",
+        "fleet.collect_seconds",
+        "fleet.straggler_intervals",
+        "fleet.step_time_skew",
+        "fleet.collective_skew_seconds",
+        "fleet.flight_seq_lag",
     }
 )
 
@@ -235,6 +268,7 @@ _CLOSED_NAMESPACES = (
     "serving.",
     "model.",
     "parallel.",
+    "fleet.",
 )
 
 # Histogram bucket edges, declared HERE so the registry (which bins
@@ -273,6 +307,11 @@ HISTOGRAM_BUCKET_EDGES: dict[str, tuple[float, ...]] = {
     "serving.queue_wait_seconds": _LATENCY_BUCKETS,
     "serving.prompt_tokens": _TOKEN_COUNT_BUCKETS,
     "serving.output_tokens": _TOKEN_COUNT_BUCKETS,
+    # One scrape = a handful of localhost/LAN HTTP round-trips: healthy
+    # collects sit in the fast-path sub-millisecond rungs, a slow or
+    # timing-out host pushes into the seconds tail — the same ladder the
+    # eager-collective block times use.
+    "fleet.collect_seconds": _FAST_LATENCY_BUCKETS,
 }
 
 # The preemption trace event train_loop emits when it drains and exits on
@@ -507,7 +546,7 @@ def validate_status_record(rec: object) -> list[str]:
     for key in ("train", "monitor", "watchdog"):
         if not isinstance(rec.get(key), dict):
             errors.append(f"'{key}' must be an object")
-    for key in ("goodput", "anomaly", "serving", "model", "parallel"):
+    for key in ("goodput", "anomaly", "serving", "model", "parallel", "fleet"):
         v = rec.get(key)
         if v is not None and not isinstance(v, dict):
             errors.append(f"'{key}' must be null or an object")
@@ -576,6 +615,101 @@ def validate_request_record(rec: object) -> list[str]:
         isinstance(k, str) and k for k in viol
     ):
         errors.append("'slo_violations' must be a list of non-empty str")
+    return errors
+
+
+def validate_fleet_snapshot(rec: object) -> list[str]:
+    """Validate one fleet-plane snapshot (schema "fluxmpi_tpu.fleet/v1",
+    produced by ``telemetry/fleet.FleetCollector.snapshot`` — and, one
+    per collection interval, appended to the JSONL bank
+    ``scripts/fleet_report.py`` replays); returns a list of error
+    strings (empty == valid).
+
+    ``hosts`` maps each scrape target to its census row: ``alive`` (the
+    last scrape succeeded), ``stale_seconds`` (age of the last GOOD
+    scrape — null until one has ever succeeded), and whatever identity
+    and signal fields that scrape yielded. ``attribution`` is the
+    interval's verdict: the blamed target (null = no straggler this
+    interval), its cause (one of STRAGGLER_CAUSES), the step-time skew
+    that triggered the blame, and the current same-host streak length.
+    ``stragglers`` is the run-cumulative verdict count per cause."""
+    if not isinstance(rec, dict):
+        return [f"fleet snapshot is not an object: {type(rec).__name__}"]
+    errors: list[str] = []
+    if rec.get("schema") != FLEET_SCHEMA:
+        errors.append(
+            f"'schema' must be {FLEET_SCHEMA!r}, got {rec.get('schema')!r}"
+        )
+    if not _is_number(rec.get("time_unix")):
+        errors.append("missing numeric 'time_unix'")
+    collects = rec.get("collects")
+    if not isinstance(collects, int) or isinstance(collects, bool):
+        errors.append("'collects' must be an int")
+    elif collects < 1:
+        errors.append("'collects' must be >= 1")
+    hosts = rec.get("hosts")
+    if not isinstance(hosts, dict) or not hosts:
+        errors.append("'hosts' must be a non-empty object")
+    else:
+        for target, row in hosts.items():
+            where = f"hosts[{target!r}]"
+            if not isinstance(target, str) or not target:
+                errors.append(f"{where}: target must be a non-empty str")
+            if not isinstance(row, dict):
+                errors.append(f"{where}: must be an object")
+                continue
+            if not isinstance(row.get("alive"), bool):
+                errors.append(f"{where}: 'alive' must be a bool")
+            stale = row.get("stale_seconds")
+            if stale is not None and (not _is_number(stale) or stale < 0):
+                errors.append(
+                    f"{where}: 'stale_seconds' must be null or >= 0"
+                )
+            if row.get("alive") and stale is None:
+                errors.append(
+                    f"{where}: an alive host must carry 'stale_seconds'"
+                )
+    attr = rec.get("attribution")
+    if not isinstance(attr, dict):
+        errors.append("'attribution' must be an object")
+    else:
+        straggler = attr.get("straggler")
+        if straggler is not None and (
+            not isinstance(straggler, str) or not straggler
+        ):
+            errors.append(
+                "attribution: 'straggler' must be null or a non-empty str"
+            )
+        cause = attr.get("cause")
+        if straggler is None:
+            if cause is not None:
+                errors.append(
+                    "attribution: 'cause' must be null without a straggler"
+                )
+        elif cause not in STRAGGLER_CAUSES:
+            errors.append(
+                f"attribution: 'cause' must be one of {STRAGGLER_CAUSES}, "
+                f"got {cause!r}"
+            )
+        streak = attr.get("streak")
+        if not isinstance(streak, int) or isinstance(streak, bool) or (
+            streak < 0
+        ):
+            errors.append("attribution: 'streak' must be an int >= 0")
+    totals = rec.get("stragglers")
+    if not isinstance(totals, dict):
+        errors.append("'stragglers' must be an object")
+    else:
+        for cause, n in totals.items():
+            if cause not in STRAGGLER_CAUSES:
+                errors.append(
+                    f"stragglers: unknown cause {cause!r} "
+                    f"(known: {STRAGGLER_CAUSES})"
+                )
+            if not isinstance(n, int) or isinstance(n, bool) or n < 0:
+                errors.append(
+                    f"stragglers[{cause!r}]: count must be an int >= 0"
+                )
     return errors
 
 

@@ -10,8 +10,11 @@ Usage:
   (schema "fluxmpi_tpu.telemetry/v1") — except lines carrying
   ``"schema": "fluxmpi_tpu.request/v1"`` (the serving plane's
   per-request terminal records, ``init(request_log=...)`` /
-  ``FLUXMPI_TPU_REQUEST_LOG``), which validate as request records —
-  and a line carrying a ``bench`` key
+  ``FLUXMPI_TPU_REQUEST_LOG``), which validate as request records,
+  and lines carrying ``"schema": "fluxmpi_tpu.fleet/v1"`` (the
+  :class:`FleetCollector`'s per-interval snapshot bank,
+  ``init(fleet=...)`` / ``FLUXMPI_TPU_FLEET``), which validate as
+  fleet snapshots — and a line carrying a ``bench`` key
   must also embed a valid bench record. Metric names in the
   framework-owned ``fault.`` / ``checkpoint.`` / ``goodput.`` /
   ``anomaly.`` / ``compile.`` / ``memory.`` namespaces must come from
@@ -119,6 +122,15 @@ def check_file(path: str, schema) -> list[str]:
                 for e in schema.validate_request_record(rec):
                     errors.append(f"{path}:{i}: {e}")
                 continue
+            if (
+                isinstance(rec, dict)
+                and rec.get("schema") == schema.FLEET_SCHEMA
+            ):
+                # Fleet snapshot line (the cross-host collector's bank,
+                # replayed by scripts/fleet_report.py).
+                for e in schema.validate_fleet_snapshot(rec):
+                    errors.append(f"{path}:{i}: {e}")
+                continue
             for e in schema.validate_record(rec):
                 errors.append(f"{path}:{i}: {e}")
             if isinstance(rec, dict) and "bench" in rec:
@@ -136,6 +148,10 @@ def check_file(path: str, schema) -> list[str]:
     if isinstance(data, dict) and data.get("schema") == schema.MANIFEST_SCHEMA:
         # Checkpoint topology manifest (the elastic-restore sidecar).
         return [f"{path}: {e}" for e in schema.validate_manifest(data)]
+    if isinstance(data, dict) and data.get("schema") == schema.FLEET_SCHEMA:
+        # A single fleet snapshot saved as .json (FleetCollector
+        # .snapshot() dumped whole rather than banked line-by-line).
+        return [f"{path}: {e}" for e in schema.validate_fleet_snapshot(data)]
     rec = _bench_record_from(data) if isinstance(data, dict) else None
     if rec is None:
         # A wrapper with no bench line is a bench that never ran — not a

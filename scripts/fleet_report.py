@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""Straggler-attribution post-mortem from fleet snapshot banks.
+
+Reads the JSONL bank a :class:`fluxmpi_tpu.telemetry.FleetCollector`
+appended (``init(fleet="fleet.jsonl")`` / ``FLUXMPI_TPU_FLEET=path`` —
+one ``fluxmpi_tpu.fleet/v1`` snapshot line per collection interval),
+replays the interval verdicts, and prints the operator view:
+
+    $ python scripts/fleet_report.py fleet.jsonl
+    fleet: 12 snapshot(s) from 1 stream(s)  hosts 2 (1 stale)
+      host 10.0.0.1:9307  alive  stale 0.2s  updates 9600
+      host 10.0.0.2:9307  STALE  last seen 12.3s ago  (status unreachable)
+      straggler intervals by cause: data_stall 7, comm_wait 1
+      blamed: 10.0.0.2:9307 x8 (data_stall 7, comm_wait 1)
+    last verdict: 10.0.0.2:9307  cause data_stall  skew 2.31x  streak 8
+
+Every per-cause total is a **registry twin** of the collector's
+cumulative ``fleet.straggler_intervals`` counter (``_REGISTRY_TWINS``
+names the pairing), so the bank and the collector host's live
+``/metrics`` endpoint can be cross-checked — if the counts disagree,
+snapshot lines were lost.
+
+Usage:
+    python scripts/fleet_report.py FILE [FILE ...] [--json]
+
+``--json`` prints one machine-readable JSON object instead of the
+table. Exit codes: 0 = fleet snapshots found and reported; 1 = inputs
+readable but NO ``fluxmpi_tpu.fleet/v1`` snapshots anywhere (the plane
+was off, or armed without a bank path); 2 = a file was
+missing/unreadable. A torn line (the collector host killed mid-write)
+is skipped with a stderr warning, never fatal (the shared
+telemetry_jsonl.py tolerance contract).
+
+Stdlib-only, no jax, no package import — runnable anywhere the bank
+landed (same contract as scripts/goodput_report.py;
+scripts/check_metrics_schema.py validates the same lines).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any
+
+# Sibling import that also works when this script is loaded by file
+# path (the test suite's importlib trick) rather than run from scripts/.
+_HERE = os.path.dirname(os.path.abspath(__file__))
+if _HERE not in sys.path:
+    sys.path.insert(0, _HERE)
+from telemetry_jsonl import scan_jsonl  # noqa: E402
+
+FLEET_SCHEMA = "fluxmpi_tpu.fleet/v1"
+
+# Bank aggregate → the collector's cumulative registry instrument
+# counting the SAME population: the cross-check contract (and the
+# fluxlint consumer-rule anchor — every literal must be schema-known).
+_REGISTRY_TWINS = {
+    "straggler_intervals": "fleet.straggler_intervals",
+    "host_count": "fleet.hosts",
+    "stale_count": "fleet.hosts_stale",
+    "flight_seq_lag": "fleet.flight_seq_lag",
+}
+
+
+def _read_banks(
+    paths: list[str],
+) -> tuple[list[tuple[str, dict]], list[str]]:
+    """All fleet snapshots across all files in bank order, tagged with
+    their source path. Returns ``(snapshots, errors)`` — errors are
+    fatal (exit 2)."""
+    rows, errors = scan_jsonl(paths, "fleet_report")
+    snaps = [
+        (path, rec)
+        for path, _lineno, rec in rows
+        if rec.get("schema") == FLEET_SCHEMA
+    ]
+    return snaps, errors
+
+
+def _aggregate(snaps: list[tuple[str, dict]]) -> dict[str, Any]:
+    last = snaps[-1][1]
+    hosts = last.get("hosts") if isinstance(last.get("hosts"), dict) else {}
+    # Blame history: which host was named per interval, with what cause
+    # — replayed from every snapshot, not just the final totals, so the
+    # report can say WHO the per-cause counts convicted.
+    blamed: dict[str, dict[str, Any]] = {}
+    for _path, snap in snaps:
+        attr = snap.get("attribution")
+        if not isinstance(attr, dict):
+            continue
+        host, cause = attr.get("straggler"), attr.get("cause")
+        if not isinstance(host, str) or not host:
+            continue
+        row = blamed.setdefault(host, {"intervals": 0, "causes": {}})
+        row["intervals"] += 1
+        if isinstance(cause, str):
+            row["causes"][cause] = row["causes"].get(cause, 0) + 1
+    stale = [t for t, h in hosts.items() if not h.get("alive")]
+    totals = last.get("stragglers")
+    return {
+        "snapshots": len(snaps),
+        "stream_count": len({path for path, _ in snaps}),
+        "host_count": len(hosts),
+        "stale_count": len(stale),
+        "hosts": hosts,
+        "stragglers": dict(totals) if isinstance(totals, dict) else {},
+        "blamed": blamed,
+        "attribution": last.get("attribution"),
+        "collects": last.get("collects"),
+        "time_unix": last.get("time_unix"),
+        "registry_twins": dict(_REGISTRY_TWINS),
+    }
+
+
+def _render(agg: dict[str, Any]) -> None:
+    print(
+        f"fleet: {agg['snapshots']} snapshot(s) from "
+        f"{agg['stream_count']} stream(s)  hosts {agg['host_count']} "
+        f"({agg['stale_count']} stale)"
+    )
+    for target in sorted(agg["hosts"]):
+        h = agg["hosts"][target]
+        stale_s = h.get("stale_seconds")
+        if h.get("alive"):
+            line = f"  host {target}  alive"
+            if isinstance(stale_s, (int, float)):
+                line += f"  stale {stale_s:.1f}s"
+        else:
+            line = f"  host {target}  STALE"
+            if isinstance(stale_s, (int, float)):
+                line += f"  last seen {stale_s:.1f}s ago"
+            else:
+                line += "  never seen"
+            if h.get("error"):
+                line += f"  ({h['error']})"
+        if h.get("updates") is not None:
+            line += f"  updates {h['updates']:g}"
+        print(line)
+    totals = agg["stragglers"]
+    if totals:
+        causes = ", ".join(
+            f"{c} {n}" for c, n in sorted(totals.items(), key=lambda e: -e[1])
+        )
+        print(f"  straggler intervals by cause: {causes}")
+    else:
+        print("  straggler intervals by cause: none — no straggler named")
+    for host in sorted(
+        agg["blamed"], key=lambda h: -agg["blamed"][h]["intervals"]
+    ):
+        row = agg["blamed"][host]
+        causes = ", ".join(
+            f"{c} {n}"
+            for c, n in sorted(row["causes"].items(), key=lambda e: -e[1])
+        )
+        line = f"  blamed: {host} x{row['intervals']}"
+        if causes:
+            line += f" ({causes})"
+        print(line)
+    attr = agg.get("attribution")
+    if isinstance(attr, dict) and attr.get("straggler"):
+        line = (
+            f"last verdict: {attr['straggler']}  cause {attr.get('cause')}"
+        )
+        if isinstance(attr.get("skew"), (int, float)):
+            line += f"  skew {attr['skew']:.2f}x"
+        if isinstance(attr.get("streak"), int):
+            line += f"  streak {attr['streak']}"
+        print(line)
+    else:
+        print("last verdict: no straggler")
+
+
+def main(argv: list[str]) -> int:
+    parser = argparse.ArgumentParser(
+        description="Straggler-attribution report from fleet snapshot "
+        "banks"
+    )
+    parser.add_argument("files", nargs="+", help="fleet snapshot JSONL file(s)")
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    args = parser.parse_args(argv)
+    snaps, errors = _read_banks(args.files)
+    for e in errors:
+        print(e, file=sys.stderr)
+    if errors:
+        return 2
+    if not snaps:
+        print(
+            f"fleet_report: no {FLEET_SCHEMA} snapshots in "
+            f"{len(args.files)} file(s) — was the run started with "
+            "FLUXMPI_TPU_FLEET=<bank path> / init(fleet='...jsonl')?",
+            file=sys.stderr,
+        )
+        return 1
+    agg = _aggregate(snaps)
+    if args.json:
+        print(json.dumps(agg))
+        return 0
+    _render(agg)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main(sys.argv[1:]))
+    except KeyboardInterrupt:
+        raise SystemExit(0)

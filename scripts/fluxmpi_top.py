@@ -181,8 +181,16 @@ def _row(
     name: str,
     status: dict[str, Any] | None,
     rates: dict[str, tuple[float, float]],
+    seen: dict[str, float] | None = None,
 ) -> str:
     if status is None:
+        # A host that ANSWERED earlier in this session and then went
+        # quiet is STALE (likely hung or dead mid-run — the interesting
+        # case), with its last-seen age; one that never answered is
+        # plain UNREACHABLE (wrong target, exporter not up yet).
+        last = (seen or {}).get(name)
+        if last is not None:
+            return f"{name:<18} STALE (last seen {time.time() - last:.0f}s ago)"
         return f"{name:<18} UNREACHABLE"
     train = status.get("train") or {}
     updates = train.get("updates")
@@ -361,11 +369,52 @@ def _parallel_rows(statuses: dict[str, Any]) -> list[str]:
     return rows
 
 
+def _fleet_rows(statuses: dict[str, Any]) -> list[str]:
+    """The FLEET block: one row per host whose ``/status`` carries the
+    cross-host collector's verdict board (the ``fleet`` section with a
+    ``collects`` counter — ingredient-only boards feed the collector,
+    not the eye) — fleet census, staleness count, and the current
+    straggler verdict with cause, streak, and the convicting skew."""
+    rows: list[str] = []
+    for name, status in statuses.items():
+        board = (status or {}).get("fleet")
+        if not isinstance(board, dict) or "collects" not in board:
+            continue
+        if not rows:
+            rows.append(
+                f"{'FLEET':<18}{'HOSTS':>6} {'STALE':>6} {'COLLECTS':>9}"
+                "  STRAGGLER"
+            )
+        straggler = board.get("straggler")
+        if isinstance(straggler, str) and straggler:
+            verdict = f"{straggler} {board.get('cause')}"
+            streak = board.get("streak")
+            if isinstance(streak, int) and streak > 1:
+                verdict += f" x{streak}"
+            skew = board.get("skew")
+            if isinstance(skew, (int, float)):
+                verdict += f" (skew {skew:.2f}x)"
+        else:
+            verdict = "(none)"
+        rows.append(
+            f"{name:<18}"
+            f"{_fmt(board.get('hosts'), '>6.0f'):>6} "
+            f"{_fmt(board.get('hosts_stale'), '>6.0f'):>6} "
+            f"{_fmt(board.get('collects'), '>9.0f'):>9}  "
+            f"{verdict}"
+        )
+    return rows
+
+
 def render_frame(
     statuses: dict[str, dict[str, Any] | None],
     rates: dict[str, tuple[float, float]],
+    seen: dict[str, float] | None = None,
 ) -> str:
-    """One dashboard frame (pure string — tests assert on it)."""
+    """One dashboard frame (pure string — tests assert on it).
+    ``seen`` is the poll loop's host → last-answered stamp map: it
+    turns a quiet host's row into STALE-with-age instead of a blank
+    UNREACHABLE."""
     up = [s for s in statuses.values() if s]
     run_ids = sorted({s.get("run_id", "?") for s in up if s.get("run_id")})
     phases = sorted(
@@ -389,7 +438,7 @@ def render_frame(
         f"{'GOODPUT':>8} {'MFU':>6} {'HB AGE':>7}  HEALTH",
     ]
     for name in statuses:
-        lines.append(_row(name, statuses[name], rates))
+        lines.append(_row(name, statuses[name], rates, seen))
     tickers: list[str] = []
     for name, s in statuses.items():
         ev = (s or {}).get("anomaly")
@@ -413,6 +462,7 @@ def render_frame(
     lines.extend(_parallel_rows(statuses))
     lines.extend(_model_rows(statuses))
     lines.extend(_serving_rows(statuses, rates))
+    lines.extend(_fleet_rows(statuses))
     return "\n".join(lines)
 
 
@@ -454,6 +504,10 @@ def main(argv: list[str]) -> int:
         parser.error("--interval must be > 0")
 
     rates: dict[str, tuple[float, float]] = {}
+    # host -> wall stamp of its last successful /status answer: a host
+    # that answered once and then went quiet renders STALE with that
+    # age, not a memoryless UNREACHABLE.
+    seen: dict[str, float] = {}
     while True:
         if args.jsonl:
             statuses: dict[str, dict[str, Any] | None] = dict(
@@ -465,6 +519,10 @@ def main(argv: list[str]) -> int:
             statuses = {
                 t: fetch_status(t, timeout=args.timeout) for t in args.targets
             }
+        now = time.time()
+        for name, status in statuses.items():
+            if status is not None:
+                seen[name] = now
         if args.json:
             print(
                 json.dumps(
@@ -472,7 +530,7 @@ def main(argv: list[str]) -> int:
                 )
             )
         else:
-            frame = render_frame(statuses, rates)
+            frame = render_frame(statuses, rates, seen)
             if not args.once:
                 sys.stdout.write(_CLEAR)
             print(frame, flush=True)

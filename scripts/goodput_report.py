@@ -45,8 +45,16 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import Any
+
+# Sibling import that also works when this script is loaded by file
+# path (the test suite's importlib trick) rather than run from scripts/.
+_HERE = os.path.dirname(os.path.abspath(__file__))
+if _HERE not in sys.path:
+    sys.path.insert(0, _HERE)
+from telemetry_jsonl import process_of, scan_jsonl  # noqa: E402
 
 
 def _extract_goodput(record: dict) -> dict[str, Any] | None:
@@ -86,45 +94,22 @@ def _extract_goodput(record: dict) -> dict[str, Any] | None:
 
 
 def _read_streams(paths: list[str]) -> tuple[dict[int, dict], list[str]]:
-    """Last goodput-carrying record per process across all files.
-    Returns ``(per_process, errors)`` — errors are fatal (exit 2)."""
+    """Last goodput-carrying record per process across all files
+    (torn lines warned-and-skipped by the shared scan — see
+    telemetry_jsonl.py for the tolerance contract). Returns
+    ``(per_process, errors)`` — errors are fatal (exit 2)."""
     per_process: dict[int, dict] = {}
-    errors: list[str] = []
-    for path in paths:
-        try:
-            with open(path, "r", encoding="utf-8") as f:
-                content = f.read()
-        except OSError as exc:
-            errors.append(f"{path}: {exc}")
+    rows, errors = scan_jsonl(paths, "goodput_report")
+    for _path, _lineno, rec in rows:
+        gp = _extract_goodput(rec)
+        if gp is None:
             continue
-        for i, line in enumerate(content.splitlines(), 1):
-            if not line.strip():
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError as exc:
-                # A torn final line is EXPECTED in the post-mortem this
-                # report exists for (a host killed mid-write); the
-                # complete records around it still carry the cumulative
-                # totals — warn and report, never refuse the fleet's
-                # data over one partial line.
-                print(
-                    f"goodput_report: skipping {path}:{i}: not JSON: {exc}",
-                    file=sys.stderr,
-                )
-                continue
-            if not isinstance(rec, dict):
-                continue
-            gp = _extract_goodput(rec)
-            if gp is None:
-                continue
-            proc = rec.get("process")
-            proc = proc if isinstance(proc, int) else 0
-            gp["process"] = proc
-            gp["time_unix"] = rec.get("time_unix")
-            # Later lines supersede earlier ones: the gauges are
-            # cumulative run totals, newest flush wins.
-            per_process[proc] = gp
+        proc = process_of(rec)
+        gp["process"] = proc
+        gp["time_unix"] = rec.get("time_unix")
+        # Later lines supersede earlier ones: the gauges are
+        # cumulative run totals, newest flush wins.
+        per_process[proc] = gp
     return per_process, errors
 
 

@@ -44,8 +44,16 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import Any
+
+# Sibling import that also works when this script is loaded by file
+# path (the test suite's importlib trick) rather than run from scripts/.
+_HERE = os.path.dirname(os.path.abspath(__file__))
+if _HERE not in sys.path:
+    sys.path.insert(0, _HERE)
+from telemetry_jsonl import process_of, scan_jsonl  # noqa: E402
 
 REQUEST_SCHEMA = "fluxmpi_tpu.request/v1"
 
@@ -68,40 +76,18 @@ def _read_streams(
 ) -> tuple[dict[tuple[int, int], dict], list[str]]:
     """All request records across all files, keyed by
     ``(process, request_id)`` (a re-read in watch mode must not double
-    count). Returns ``(records, errors)`` — errors are fatal (exit 2)."""
+    count; torn lines warned-and-skipped by the shared scan — see
+    telemetry_jsonl.py for the tolerance contract). Returns
+    ``(records, errors)`` — errors are fatal (exit 2)."""
     records: dict[tuple[int, int], dict] = {}
-    errors: list[str] = []
-    for path in paths:
-        try:
-            with open(path, "r", encoding="utf-8") as f:
-                content = f.read()
-        except OSError as exc:
-            errors.append(f"{path}: {exc}")
+    rows, errors = scan_jsonl(paths, "serving_report")
+    for _path, _lineno, rec in rows:
+        if rec.get("schema") != REQUEST_SCHEMA:
             continue
-        for i, line in enumerate(content.splitlines(), 1):
-            if not line.strip():
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError as exc:
-                # A torn final line is EXPECTED post-mortem (a host
-                # killed mid-write); the complete records around it
-                # still describe the population — warn, never refuse.
-                print(
-                    f"serving_report: skipping {path}:{i}: not JSON: {exc}",
-                    file=sys.stderr,
-                )
-                continue
-            if (
-                not isinstance(rec, dict)
-                or rec.get("schema") != REQUEST_SCHEMA
-            ):
-                continue
-            proc = rec.get("process")
-            proc = proc if isinstance(proc, int) else 0
-            rid = rec.get("request_id")
-            rid = rid if isinstance(rid, int) else len(records)
-            records[(proc, rid)] = rec
+        proc = process_of(rec)
+        rid = rec.get("request_id")
+        rid = rid if isinstance(rid, int) else len(records)
+        records[(proc, rid)] = rec
     return records, errors
 
 

@@ -1,0 +1,70 @@
+"""Shared JSONL-scan helper for the scripts/ report CLIs.
+
+``goodput_report.py``, ``serving_report.py``, and ``fleet_report.py``
+all read the same kind of artifact — per-host JSONL banks a run
+appended to until it (possibly) died mid-write — and they must agree on
+the tolerance contract:
+
+- a missing/unreadable FILE is the caller's problem (collected into the
+  returned ``errors`` list; one-shot report modes exit 2 on it, watch
+  modes render it as a waiting state);
+- a torn or corrupt LINE (a host killed mid-write — the very
+  post-mortem these reports serve) is skipped with a stderr warning
+  naming the tool, file, and line, and is NEVER fatal: the complete
+  records around it still carry the data;
+- non-object JSON lines are dropped silently (foreign stream noise).
+
+Stdlib-only, no jax, no package import — the same runnable-anywhere
+contract as the reports themselves. Imported as a sibling module: the
+reports put their own directory on ``sys.path`` first, so both
+``python scripts/goodput_report.py`` and the test suite's
+import-by-file-path find it.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any
+
+
+def scan_jsonl(
+    paths: list[str], tool: str
+) -> tuple[list[tuple[str, int, dict]], list[str]]:
+    """Every well-formed JSON object line across ``paths``, in
+    file-then-line order.
+
+    Returns ``(rows, errors)``: rows are ``(path, lineno, record)``
+    triples; errors are per-file open/read failures (the caller decides
+    whether those are fatal). ``tool`` names the report in the
+    torn-line stderr warning.
+    """
+    rows: list[tuple[str, int, dict]] = []
+    errors: list[str] = []
+    for path in paths:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                content = f.read()
+        except OSError as exc:
+            errors.append(f"{path}: {exc}")
+            continue
+        for i, line in enumerate(content.splitlines(), 1):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as exc:
+                print(
+                    f"{tool}: skipping {path}:{i}: not JSON: {exc}",
+                    file=sys.stderr,
+                )
+                continue
+            if isinstance(rec, dict):
+                rows.append((path, i, rec))
+    return rows, errors
+
+
+def process_of(rec: dict[str, Any]) -> int:
+    """The record's host process index (0 when absent or invalid)."""
+    proc = rec.get("process")
+    return proc if isinstance(proc, int) else 0

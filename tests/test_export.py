@@ -629,6 +629,25 @@ def _check_request_log():
     assert obs.burn.total == 0  # windows cleared
 
 
+def _arm_fleet(tmp_path):
+    from fluxmpi_tpu.telemetry import fleet as fleet_mod
+
+    collector = fleet_mod.FleetCollector(
+        ["127.0.0.1:1"], interval=60.0
+    ).start()
+    fleet_mod.configure(collector)
+    assert fleet_mod.enabled() and collector.running
+    _arm_fleet.collector = collector
+
+
+def _check_fleet():
+    from fluxmpi_tpu.telemetry import fleet as fleet_mod
+
+    assert not fleet_mod.enabled()
+    assert fleet_mod.get_fleet_collector() is None
+    assert not _arm_fleet.collector.running  # thread stopped, not leaked
+
+
 _PLANES = [
     ("registry", _arm_registry, _check_registry),
     ("tracer", _arm_tracer, _check_tracer),
@@ -643,6 +662,7 @@ _PLANES = [
     ("exporter", _arm_exporter, _check_exporter),
     ("serving", _arm_serving, _check_serving),
     ("request_log", _arm_request_log, _check_request_log),
+    ("fleet", _arm_fleet, _check_fleet),
 ]
 
 

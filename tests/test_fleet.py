@@ -1,0 +1,662 @@
+"""Fleet plane tests (telemetry/fleet.py + the persistent_straggler
+rule + scripts/fleet_report.py): target resolution and constructor
+validation, the straggler attribution engine (all four causes, the
+skew threshold, counter resets, desync on frozen flight sequences),
+collector tolerance (dead host, garbage and schema-invalid /status),
+snapshot schema + closed fleet.* namespace, configure()/env wiring,
+the zero-cost-when-off contract in train_loop, the monitor's skew
+gauges, and the E2E acceptance loop: a fault-injected data stall on
+one virtual host is named straggler with cause data_stall, the
+persistent_straggler anomaly fires exactly once per streak, and the
+snapshot bank replays through fleet_report.py and
+check_metrics_schema.py."""
+
+import http.server
+import json
+import os
+import socketserver
+import subprocess
+import sys
+import threading
+import urllib.request
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import optax
+
+from fluxmpi_tpu import faults
+from fluxmpi_tpu.data import ArrayDataset, DistributedDataLoader
+from fluxmpi_tpu.parallel import TrainState, make_train_step, train_loop
+from fluxmpi_tpu.parallel.train import replicate
+from fluxmpi_tpu.telemetry import MetricsRegistry, export, get_registry
+from fluxmpi_tpu.telemetry import anomaly as anomaly_mod
+from fluxmpi_tpu.telemetry import fleet as fleet_mod
+from fluxmpi_tpu.telemetry import goodput as goodput_mod
+from fluxmpi_tpu.telemetry.export import Exporter
+from fluxmpi_tpu.telemetry.fleet import FleetCollector
+from fluxmpi_tpu.telemetry.monitor import TrainingMonitor
+from fluxmpi_tpu.telemetry.schema import (
+    KNOWN_METRIC_NAMES,
+    validate_fleet_snapshot,
+    validate_record,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FLEET_REPORT = os.path.join(_REPO, "scripts", "fleet_report.py")
+_CHECK_SCHEMA = os.path.join(_REPO, "scripts", "check_metrics_schema.py")
+_TOP = os.path.join(_REPO, "scripts", "fluxmpi_top.py")
+
+
+@pytest.fixture(autouse=True)
+def _fleet_reset():
+    """Every test leaves the module-level plane disarmed — the
+    fault-plane leak rule, enforced at the fixture level so a failing
+    assertion cannot leak a collector thread into the next test."""
+    yield
+    fleet_mod.shutdown()
+
+
+def _exporter(registry=None):
+    exp = Exporter(0, "127.0.0.1", registry=registry, deadline=3600.0)
+    exp.start()
+    return exp
+
+
+def _stub_server(body: bytes, status: int = 200):
+    """A minimal HTTP server answering every GET with ``body`` — the
+    wrong-service / torn-response scrape targets."""
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    srv = socketserver.TCPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=5
+    ) as resp:
+        return resp.read()
+
+
+def _mlp_pieces(world, n=256):
+    import jax.numpy as jnp
+
+    from fluxmpi_tpu.models import MLP
+
+    model = MLP(features=(8, 8, 1))
+
+    def loss_fn(p, ms, b):
+        bx, by = b
+        return jnp.mean((model.apply(p, bx) - by) ** 2), ms
+
+    opt = optax.adam(1e-3)
+    rng = np.random.default_rng(0)
+    x = rng.uniform(-2, 2, size=(n, 1)).astype(np.float32)
+    params = jax.device_get(
+        model.init(jax.random.PRNGKey(0), np.zeros((2, 1), np.float32))
+    )
+    return loss_fn, opt, params, ArrayDataset((x, x**2))
+
+
+# ---------------------------------------------------------------------------
+# Construction + target resolution
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_targets_and_validation():
+    c = FleetCollector("hostA,hostB:9999", interval=1.0)
+    assert c.targets == ("hostA:9307", "hostB:9999")
+    with pytest.raises(ValueError):
+        FleetCollector([])
+    with pytest.raises(ValueError):
+        FleetCollector(["h:bogus"])
+    with pytest.raises(ValueError):
+        FleetCollector(["a", "a"])  # duplicate identity
+    with pytest.raises(ValueError):
+        FleetCollector(["a"], interval=0)
+    with pytest.raises(ValueError):
+        FleetCollector(["a"], timeout=0)
+    with pytest.raises(ValueError):
+        FleetCollector(["a"], straggler_threshold=1.0)
+    with pytest.raises(ValueError):
+        FleetCollector(["a"], cause_significance=1.5)
+
+
+def test_parse_metrics_text_demangles_and_skips_foreign():
+    text = "\n".join(
+        [
+            "# HELP fluxmpi_comm_block__seconds histogram",
+            'fluxmpi_comm_block__seconds_sum{op="allreduce",path="x"} 1.5',
+            "fluxmpi_goodput_wall__seconds 10.0",
+            "node_cpu_seconds_total 99",  # foreign exporter: skipped
+            "torn line without a number trailing",
+        ]
+    )
+    rows = fleet_mod._parse_metrics_text(text)
+    by_name = {r["name"]: r for r in rows}
+    assert by_name["comm.block_seconds"]["value"] == 1.5
+    assert by_name["comm.block_seconds"]["labels"]["op"] == "allreduce"
+    assert by_name["goodput.wall_seconds"]["value"] == 10.0
+    assert "node_cpu_seconds_total" not in {r["series"] for r in rows}
+
+
+# ---------------------------------------------------------------------------
+# Attribution engine (unit — no HTTP)
+# ---------------------------------------------------------------------------
+
+
+def _collector2():
+    return FleetCollector(["a:1", "b:1"], interval=60.0)
+
+
+def test_attribution_names_data_stall():
+    c = _collector2()
+    verdict = c._attribute(
+        {
+            "a:1": {
+                "wall_seconds": 10.0, "updates": 10.0,
+                "data_stall_seconds": 6.0, "comm_block_seconds": 0.1,
+            },
+            "b:1": {"wall_seconds": 10.0, "updates": 100.0},
+        }
+    )
+    assert verdict["straggler"] == "a:1"
+    assert verdict["cause"] == "data_stall"
+    assert verdict["skew"] == pytest.approx(10.0)
+
+
+def test_attribution_names_comm_wait():
+    c = _collector2()
+    verdict = c._attribute(
+        {
+            "a:1": {
+                "wall_seconds": 10.0, "updates": 10.0,
+                "data_stall_seconds": 0.1, "comm_block_seconds": 5.0,
+            },
+            "b:1": {"wall_seconds": 10.0, "updates": 100.0},
+        }
+    )
+    assert verdict["straggler"] == "a:1"
+    assert verdict["cause"] == "comm_wait"
+
+
+def test_attribution_falls_through_to_compute():
+    c = _collector2()
+    verdict = c._attribute(
+        {
+            "a:1": {
+                "wall_seconds": 10.0, "updates": 10.0,
+                "data_stall_seconds": 0.2, "comm_block_seconds": 0.2,
+            },
+            "b:1": {"wall_seconds": 10.0, "updates": 100.0},
+        }
+    )
+    assert verdict["straggler"] == "a:1"
+    assert verdict["cause"] == "compute"
+
+
+def test_attribution_below_threshold_names_nobody():
+    c = _collector2()
+    verdict = c._attribute(
+        {
+            "a:1": {"wall_seconds": 10.0, "updates": 10.0},
+            "b:1": {"wall_seconds": 10.0, "updates": 12.0},
+        }
+    )
+    assert verdict["straggler"] is None and verdict["cause"] is None
+    assert 1.0 < verdict["skew"] < 1.5
+
+
+def test_attribution_desync_on_frozen_flight_sequence():
+    c = _collector2()
+    # Interval 1 primes the delta base (cumulative-as-interval).
+    c._prev = {
+        "a:1": {"wall_seconds": 10.0, "updates": 10.0, "flight_seq": 50.0},
+        "b:1": {"wall_seconds": 10.0, "updates": 10.0, "flight_seq": 50.0},
+    }
+    # Interval 2: a's launch sequence FROZE while b's advanced.
+    verdict = c._attribute(
+        {
+            "a:1": {"wall_seconds": 20.0, "updates": 10.0, "flight_seq": 50.0},
+            "b:1": {"wall_seconds": 20.0, "updates": 20.0, "flight_seq": 90.0},
+        }
+    )
+    assert verdict["straggler"] == "a:1"
+    assert verdict["cause"] == "desync"
+    assert verdict["seq_lag"] == 40.0
+
+
+def test_deltas_tolerate_counter_reset():
+    c = _collector2()
+    c._prev["a:1"] = {"wall_seconds": 100.0, "updates": 90.0}
+    # The host restarted: cumulative counters fell. The delta must read
+    # the new cumulative value as one interval from zero, never negative.
+    out = c._deltas("a:1", {"wall_seconds": 5.0, "updates": 4.0})
+    assert out["wall_seconds"] == 5.0 and out["updates"] == 4.0
+
+
+# ---------------------------------------------------------------------------
+# persistent_straggler anomaly rule
+# ---------------------------------------------------------------------------
+
+
+def test_persistent_straggler_fires_once_per_streak(tmp_path, monkeypatch):
+    monkeypatch.setenv("FLUXMPI_TPU_ANOMALY_DIR", str(tmp_path))
+    det = anomaly_mod.AnomalyDetector(persistent_straggler_intervals=3)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        assert det.observe_straggler("h1") == []  # streak 1
+        assert det.observe_straggler("h1") == []  # streak 2
+        events = det.observe_straggler("h1")  # streak 3: fires
+        assert len(events) == 1
+        ev = events[0]
+        assert ev["rule"] == "persistent_straggler"
+        assert ev["action"] == "warn"  # never halt: outside the SPMD world
+        assert ev["host"] == "h1" and ev["value"] == 3.0
+        assert det.observe_straggler("h1") == []  # streak 4: once per streak
+        # A clean interval re-arms the rule.
+        assert det.observe_straggler(None) == []
+        assert det.observe_straggler("h1") == []
+        assert det.observe_straggler("h1") == []
+        assert len(det.observe_straggler("h1")) == 1
+
+
+def test_persistent_straggler_host_switch_restarts_streak(
+    tmp_path, monkeypatch
+):
+    monkeypatch.setenv("FLUXMPI_TPU_ANOMALY_DIR", str(tmp_path))
+    det = anomaly_mod.AnomalyDetector(persistent_straggler_intervals=2)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        assert det.observe_straggler("h1") == []
+        assert det.observe_straggler("h2") == []  # blame moved: streak 1
+        events = det.observe_straggler("h2")
+        assert len(events) == 1 and events[0]["host"] == "h2"
+
+
+def test_persistent_straggler_validates_intervals():
+    with pytest.raises(ValueError):
+        anomaly_mod.AnomalyDetector(persistent_straggler_intervals=0)
+
+
+# ---------------------------------------------------------------------------
+# Collector tolerance + snapshot schema + metrics
+# ---------------------------------------------------------------------------
+
+
+def test_collector_tolerates_dead_host():
+    reg = MetricsRegistry()
+    exp = _exporter(registry=reg)
+    exp.note_fleet(wall_seconds=5.0, updates=10.0)
+    try:
+        c = FleetCollector(
+            [f"127.0.0.1:{exp.port}", "127.0.0.1:1"],
+            interval=60.0, timeout=0.5, registry=MetricsRegistry(),
+        )
+        snap = c.collect_once()  # must not raise
+        live = snap["hosts"][f"127.0.0.1:{exp.port}"]
+        dead = snap["hosts"]["127.0.0.1:1"]
+        assert live["alive"] is True and live["stale_seconds"] == pytest.approx(
+            0.0, abs=5.0
+        )
+        assert dead["alive"] is False and dead["stale_seconds"] is None
+        assert "unreachable" in dead["error"]
+        assert validate_fleet_snapshot(snap) == []
+    finally:
+        exp.stop()
+
+
+def test_collector_tolerates_garbage_and_invalid_status():
+    torn = _stub_server(b'{"schema": "fluxmpi_tpu.status/v1", "tim')
+    foreign = _stub_server(json.dumps({"schema": "acme.metrics/v9"}).encode())
+    try:
+        targets = [
+            f"127.0.0.1:{torn.server_address[1]}",
+            f"127.0.0.1:{foreign.server_address[1]}",
+        ]
+        c = FleetCollector(
+            targets, interval=60.0, timeout=1.0, registry=MetricsRegistry()
+        )
+        snap = c.collect_once()  # must not raise
+        torn_row = snap["hosts"][targets[0]]
+        foreign_row = snap["hosts"][targets[1]]
+        assert torn_row["alive"] is False
+        assert "unreachable" in torn_row["error"]
+        assert foreign_row["alive"] is False
+        assert foreign_row["error"] == "invalid /status record"
+        assert snap["attribution"]["straggler"] is None
+        assert validate_fleet_snapshot(snap) == []
+    finally:
+        torn.shutdown()
+        foreign.shutdown()
+
+
+def test_collect_records_closed_namespace_metrics():
+    reg_a, reg_b = MetricsRegistry(), MetricsRegistry()
+    exp_a, exp_b = _exporter(reg_a), _exporter(reg_b)
+    exp_a.note_fleet(
+        wall_seconds=10.0, updates=10.0, data_stall_seconds=4.0,
+        comm_block_seconds=0.1, flight_seq=10.0,
+    )
+    exp_b.note_fleet(
+        wall_seconds=10.0, updates=100.0, data_stall_seconds=0.1,
+        comm_block_seconds=0.1, flight_seq=100.0,
+    )
+    creg = MetricsRegistry()
+    try:
+        c = FleetCollector(
+            [f"127.0.0.1:{exp_a.port}", f"127.0.0.1:{exp_b.port}"],
+            interval=60.0, registry=creg,
+        )
+        snap = c.collect_once()
+        assert snap["attribution"]["cause"] == "data_stall"
+        assert snap["attribution"]["flight_seq_lag"] == 90.0
+        names = {m["name"] for m in creg.snapshot()}
+        assert {
+            "fleet.hosts", "fleet.hosts_stale", "fleet.collect_seconds",
+            "fleet.straggler_intervals", "fleet.flight_seq_lag",
+        } <= names
+        assert names <= set(KNOWN_METRIC_NAMES) | {
+            n for n in names if not n.startswith("fleet.")
+        }
+        # The flushed record passes the telemetry schema (the closed
+        # fleet.* namespace admits exactly the known names).
+        assert validate_record(creg.flush()) == []
+        # The read API returns the same model the bank gets.
+        assert c.snapshot()["collects"] == snap["collects"]
+    finally:
+        exp_a.stop()
+        exp_b.stop()
+
+
+def test_validate_fleet_snapshot_rejects_drift():
+    assert validate_fleet_snapshot({"schema": "nope"})
+    good = {
+        "schema": "fluxmpi_tpu.fleet/v1",
+        "time_unix": 1.0,
+        "collects": 1,
+        "hosts": {"h:1": {"alive": True, "stale_seconds": 0.0}},
+        "attribution": {"straggler": None, "cause": None, "streak": 0},
+        "stragglers": {},
+    }
+    assert validate_fleet_snapshot(good) == []
+    bad_cause = json.loads(json.dumps(good))
+    bad_cause["attribution"] = {
+        "straggler": "h:1", "cause": "gremlins", "streak": 1,
+    }
+    assert any("cause" in e for e in validate_fleet_snapshot(bad_cause))
+    bad_counts = json.loads(json.dumps(good))
+    bad_counts["stragglers"] = {"data_stall": -1}
+    assert validate_fleet_snapshot(bad_counts)
+
+
+# ---------------------------------------------------------------------------
+# configure() / env wiring
+# ---------------------------------------------------------------------------
+
+
+def test_configure_forms(monkeypatch):
+    monkeypatch.delenv("FLUXMPI_TPU_FLEET", raising=False)
+    # None + unset env: no-op, stays disarmed.
+    assert fleet_mod.configure(None) is None
+    assert not fleet_mod.enabled()
+    # Explicit collector installs, arms, and starts.
+    c = FleetCollector(["127.0.0.1:1"], interval=60.0)
+    assert fleet_mod.configure(c) is c
+    assert fleet_mod.enabled() and c.running
+    # Idempotent replay keeps the running instance.
+    assert fleet_mod.configure(True) is c
+    # A replacement collector stops the old one.
+    c2 = FleetCollector(["127.0.0.1:2"], interval=60.0)
+    fleet_mod.configure(c2)
+    assert not c.running and c2.running
+    # False disarms and stops.
+    assert fleet_mod.configure(False) is None
+    assert not fleet_mod.enabled() and not c2.running
+    # Env-driven arming with an interval + hosts override.
+    monkeypatch.setenv("FLUXMPI_TPU_FLEET", "1")
+    monkeypatch.setenv("FLUXMPI_TPU_FLEET_HOSTS", "127.0.0.1:1")
+    monkeypatch.setenv("FLUXMPI_TPU_FLEET_INTERVAL", "42.5")
+    c3 = fleet_mod.configure(None)
+    assert fleet_mod.enabled() and c3.interval == 42.5
+    assert c3.targets == ("127.0.0.1:1",)
+    # "0" resets.
+    monkeypatch.setenv("FLUXMPI_TPU_FLEET", "0")
+    fleet_mod.configure(None)
+    assert not fleet_mod.enabled() and not c3.running
+    with pytest.raises(ValueError):
+        fleet_mod.configure(3.14)
+
+
+def test_env_interval_typo_warns_and_uses_default(monkeypatch):
+    monkeypatch.setenv("FLUXMPI_TPU_FLEET_INTERVAL", "fast")
+    with pytest.warns(UserWarning, match="FLUXMPI_TPU_FLEET_INTERVAL"):
+        assert fleet_mod._env_interval() == 5.0
+
+
+def test_path_spec_banks_snapshots(tmp_path, monkeypatch):
+    bank = tmp_path / "fleet.jsonl"
+    exp = _exporter(MetricsRegistry())
+    monkeypatch.setenv("FLUXMPI_TPU_FLEET_HOSTS", f"127.0.0.1:{exp.port}")
+    try:
+        c = fleet_mod.configure(str(bank))
+        assert c is not None and c.log == str(bank)
+        c.collect_once()
+        lines = bank.read_text().splitlines()
+        assert len(lines) == 1
+        assert validate_fleet_snapshot(json.loads(lines[0])) == []
+    finally:
+        exp.stop()
+
+
+# ---------------------------------------------------------------------------
+# train_loop / monitor wiring
+# ---------------------------------------------------------------------------
+
+
+def test_train_loop_fleet_off_never_posts_ingredients(world, monkeypatch):
+    """The zero-cost contract, monkeypatch-explode style: exporter ON
+    but fleet OFF, a run must never touch the FLEET board or the
+    collector."""
+    assert not fleet_mod.enabled()
+
+    def explode(*a, **k):
+        raise AssertionError("fleet plane touched on the fully-off path")
+
+    monkeypatch.setattr(Exporter, "note_fleet", explode)
+    monkeypatch.setattr(FleetCollector, "collect_once", explode)
+    get_registry().reset()
+    export.configure(Exporter(0, "127.0.0.1", deadline=3600.0))
+    try:
+        loss_fn, opt, params, ds = _mlp_pieces(world)
+        loader = DistributedDataLoader(ds, 64, mesh=world)
+        step = make_train_step(loss_fn, opt, mesh=world)
+        state = replicate(TrainState.create(params, opt, None), world)
+        _, summary = train_loop(step, state, loader, epochs=1, flush_every=2)
+        assert summary["updates"] == 4
+    finally:
+        export.shutdown()
+
+
+def test_monitor_skew_gauges_ride_the_collect(monkeypatch):
+    reg = MetricsRegistry()
+    reg.histogram("comm.block_seconds", op="allreduce", path="x").observe(0.5)
+    mon = TrainingMonitor(registry=reg, interval=2, cross_host=False)
+    # Off: no fleet.* gauges on the collect.
+    mon.observe_step(0.1)
+    summary = mon.observe_step(0.1)
+    assert "step_time_skew" not in summary
+    # Armed: the same collect publishes the skew trio (single host: a
+    # 1.0 ratio and zero spreads — the degenerate-but-schema'd shape).
+    fleet_mod.configure(FleetCollector(["127.0.0.1:1"], interval=60.0))
+    mon.observe_step(0.1)
+    summary = mon.observe_step(0.1)
+    assert summary["step_time_skew"] == pytest.approx(1.0)
+    assert summary["collective_skew_seconds"] == 0.0
+    assert summary["flight_seq_lag"] == 0.0
+    names = {m["name"] for m in reg.snapshot()}
+    assert {
+        "fleet.step_time_skew",
+        "fleet.collective_skew_seconds",
+        "fleet.flight_seq_lag",
+    } <= names
+
+
+# ---------------------------------------------------------------------------
+# fleet_report.py CLI
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_report_exit_codes(tmp_path):
+    # Readable input with no fleet snapshots -> exit 1, pointed message.
+    plain = tmp_path / "plain.jsonl"
+    plain.write_text('{"schema": "fluxmpi_tpu.telemetry/v1"}\n')
+    proc = subprocess.run(
+        [sys.executable, _FLEET_REPORT, str(plain)],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 1
+    assert "FLUXMPI_TPU_FLEET" in proc.stderr
+    # Missing file -> exit 2.
+    proc = subprocess.run(
+        [sys.executable, _FLEET_REPORT, str(tmp_path / "missing.jsonl")],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 2
+
+
+def test_fleet_report_tolerates_torn_line(tmp_path):
+    bank = tmp_path / "torn.jsonl"
+    snap = {
+        "schema": "fluxmpi_tpu.fleet/v1", "time_unix": 1.0, "collects": 1,
+        "hosts": {"h:1": {"alive": True, "stale_seconds": 0.1}},
+        "attribution": {
+            "straggler": "h:1", "cause": "compute", "skew": 2.0, "streak": 1,
+        },
+        "stragglers": {"compute": 1},
+    }
+    bank.write_text(json.dumps(snap) + "\n" + '{"schema": "fluxmpi_tp')
+    proc = subprocess.run(
+        [sys.executable, _FLEET_REPORT, str(bank)],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "skipping" in proc.stderr
+    assert "cause compute" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# E2E acceptance: fault-injected stall -> attribution -> bank round trip
+# ---------------------------------------------------------------------------
+
+
+def test_e2e_fleet_names_stalled_host(world, tmp_path, monkeypatch):
+    """The acceptance loop: two virtual hosts (this process's real run
+    + a synthetic healthy peer), a fault-injected data.fetch delay on
+    the real one. The collector names the stalled host straggler with
+    cause data_stall, persistent_straggler fires exactly once per
+    streak, the bank replays through fleet_report.py with the same
+    attribution, and every snapshot line passes
+    check_metrics_schema.py."""
+    monkeypatch.setenv("FLUXMPI_TPU_ANOMALY_DIR", str(tmp_path))
+    get_registry().reset()
+    bank = tmp_path / "fleet.jsonl"
+    # Virtual healthy peer: its own registry + exporter + FLEET board
+    # reading as a fast host (tiny per-update wall, no badput).
+    exp_b = _exporter(MetricsRegistry())
+    exp_b.note_fleet(
+        wall_seconds=10.0, step_seconds=9.5, data_stall_seconds=0.1,
+        host_idle_seconds=0.4, comm_block_seconds=0.05,
+        updates=2000.0, flight_seq=2000.0,
+    )
+    # The real host: live exporter over the global registry; goodput +
+    # fleet planes armed so train_loop posts real ingredients.
+    exp_a = Exporter(0, "127.0.0.1", deadline=3600.0)
+    export.configure(exp_a)
+    goodput_mod.configure(True)
+    a_target = f"127.0.0.1:{exp_a.port}"
+    b_target = f"127.0.0.1:{exp_b.port}"
+    detector = anomaly_mod.AnomalyDetector(persistent_straggler_intervals=2)
+    collector = FleetCollector(
+        [a_target, b_target], interval=60.0, log=str(bank),
+        registry=MetricsRegistry(), detector=detector,
+    )
+    fleet_mod.configure(collector)
+    try:
+        loss_fn, opt, params, ds = _mlp_pieces(world)
+        loader = DistributedDataLoader(ds, 64, mesh=world)
+        step = make_train_step(loss_fn, opt, mesh=world, metrics=True)
+        state = replicate(TrainState.create(params, opt, None), world)
+        # Six fetches each stall 0.2 s: the run's badput is dominated
+        # by the data_stall bucket (>= 1.2 s of a few-second wall).
+        with faults.scope("data.fetch:delay=0.2:times=6"):
+            _, summary = train_loop(
+                step, state, loader, epochs=2, flush_every=2, fuse=False
+            )
+        status = json.loads(_get(exp_a.port, "/status"))
+        board = status["fleet"]  # the per-flush ingredient post
+        assert board["updates"] == summary["updates"]
+        assert board["data_stall_seconds"] >= 1.0
+        # A single-process mesh run issues no explicit comm-layer
+        # collectives, so the flight sequence legitimately reads 0 —
+        # the key must still be on the board for the collector.
+        assert board["flight_seq"] >= 0
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            snaps = [collector.collect_once() for _ in range(3)]
+        for snap in snaps:
+            assert snap["attribution"]["straggler"] == a_target
+            assert snap["attribution"]["cause"] == "data_stall"
+            assert validate_fleet_snapshot(snap) == []
+        assert [s["attribution"]["streak"] for s in snaps] == [1, 2, 3]
+        assert snaps[-1]["stragglers"] == {"data_stall": 3}
+        fired = [
+            w for w in caught if "persistent_straggler" in str(w.message)
+        ]
+        assert len(fired) == 1, "once per streak, not per interval"
+        # The verdict is on the local /status FLEET board (fluxmpi_top's
+        # surface) next to the ingredients.
+        board = json.loads(_get(exp_a.port, "/status"))["fleet"]
+        assert board["straggler"] == a_target
+        assert board["cause"] == "data_stall" and board["collects"] == 3
+        top = subprocess.run(
+            [sys.executable, _TOP, a_target, "--once"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert top.returncode == 0, top.stderr
+        assert "FLEET" in top.stdout and "data_stall" in top.stdout
+        # Bank round trip: fleet_report reads the same attribution back.
+        rep = subprocess.run(
+            [sys.executable, _FLEET_REPORT, str(bank), "--json"],
+            capture_output=True, text=True,
+        )
+        assert rep.returncode == 0, rep.stderr
+        agg = json.loads(rep.stdout)
+        assert agg["snapshots"] == 3
+        assert agg["attribution"]["straggler"] == a_target
+        assert agg["attribution"]["cause"] == "data_stall"
+        assert agg["stragglers"] == {"data_stall": 3}
+        assert agg["blamed"][a_target]["intervals"] == 3
+        # And every bank line is schema-clean.
+        chk = subprocess.run(
+            [sys.executable, _CHECK_SCHEMA, str(bank)],
+            capture_output=True, text=True,
+        )
+        assert chk.returncode == 0, chk.stdout + chk.stderr
+    finally:
+        goodput_mod.configure(False)
+        export.shutdown()
+        exp_b.stop()
