@@ -888,7 +888,7 @@ def _bench_train_loop():
         )
         return summary
 
-    run(1, state0)  # warmup: jit + the window's AOT compile (cached)
+    warm = run(1, state0)  # warmup: jit + the window's AOT compile (cached)
     epochs = max(2, int(os.environ.get("FLUXMPI_TPU_BENCH_STEPS", "24")) //
                  window)
     summary = run(epochs, fresh_state())
@@ -922,7 +922,94 @@ def _bench_train_loop():
                 summary["dispatches"] / summary["updates"], 4
             ),
             "updates": summary["updates"],
+            # The window AOT-compile cost lands in the warmup run; the
+            # timed run must be a pure cache hit on the step's
+            # (width, lbs, aval-fingerprint) window cache — recorded so
+            # the per-leg saving is visible on the bench record.
+            "compile_seconds": round(
+                warm.get("window_compile_seconds") or 0.0, 3
+            ),
+            "window_cache": summary.get("window_cache"),
         },
+    }
+
+
+def _bench_autotune():
+    """Layout-autotuner leg: ``init(parallel="auto")`` over the same
+    TransformerLM workload the train_loop leg drives — the four-stage
+    search (enumerate every dp×fsdp×tp factorization, prune on the
+    static memory + AOT-cost models, fused-window trials for the
+    survivors, bank the winner) end to end on the real machinery. The
+    record's headline is the WINNER's fused-window throughput and the
+    full ``fluxmpi_tpu.autotune/v1`` candidate table rides along under
+    ``autotune`` (static scores + trial throughputs — the evidence the
+    winner beat the hand-picked legs), validated by
+    ``scripts/check_metrics_schema.py`` like every other contract."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import fluxmpi_tpu as fm
+    from fluxmpi_tpu.models import TransformerLM
+    from fluxmpi_tpu.parallel.autotune import autotune
+
+    devs = _visible_devices()
+    fm.init(devices=devs, parallel="auto", compileplane=True)
+    n_dev = len(devs)
+    device_kind = devs[0].device_kind
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        vocab, seq = 8192, 256
+        dims = dict(num_layers=4, d_model=512, num_heads=8, d_ff=2048)
+        per_dev = 8
+    else:
+        vocab, seq = 256, 64
+        dims = dict(num_layers=2, d_model=64, num_heads=4, d_ff=128)
+        per_dev = 8
+    window = 8
+    gbs = per_dev * n_dev
+    model = TransformerLM(vocab_size=vocab, max_len=seq, **dims)
+    rng = np.random.default_rng(0)
+    batch = (
+        rng.integers(0, vocab, size=(gbs, seq)).astype(np.int32),
+        rng.integers(0, vocab, size=(gbs, seq)).astype(np.int32),
+    )
+    optimizer = optax.adamw(1e-4)
+
+    def loss_fn(p, mstate, b):
+        bx, by = b
+        logits = model.apply(p, bx, train=False)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), by
+        ).mean()
+        return loss, mstate
+
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32), train=False
+    )
+    res = autotune(
+        loss_fn, optimizer, params, batch,
+        devices=devs, window=window, trial_epochs=2,
+        fsdp_min_size=256, seed=0, force=True,
+    )
+    winner = next(
+        c for c in res.record["candidates"]
+        if c["pruned"] is None and c["axes"] == res.record["winner"]["axes"]
+    )
+    eps = winner["trial"]["examples_per_sec"]
+    value = round(eps * seq / n_dev, 1)
+    metric = "autotune_tokens_per_sec_per_chip"
+    anchor = _anchor_for(metric)
+    return {
+        "metric": metric,
+        "value": value,
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(value / anchor, 4) if anchor else 1.0,
+        "platform": jax.default_backend(),
+        "device_kind": device_kind,
+        "n_chips": n_dev,
+        "autotune": res.record,
     }
 
 
@@ -1336,6 +1423,7 @@ _CHILD_FNS = {
     "unet": _bench_unet,
     "serving": _bench_serving,
     "train_loop": _bench_train_loop,
+    "autotune": _bench_autotune,
 }
 
 
@@ -1652,6 +1740,8 @@ def _axis_leg_summary(rec: dict) -> dict:
         "dispatches_per_update": par.get("dispatches_per_update"),
         "sharded_param_leaves": par.get("sharded_param_leaves"),
         "rule_hits": par.get("rule_hits"),
+        "compile_seconds": par.get("compile_seconds"),
+        "window_cache": par.get("window_cache"),
     }
 
 
@@ -1801,9 +1891,12 @@ def _run_smoke(remaining) -> None:
     # entry point: FLUXMPI_TPU_BENCH_SMOKE=1 + _CONFIG=serving); the
     # scaling pair only applies to the default mlp smoke.
     config = os.environ.get("FLUXMPI_TPU_BENCH_CONFIG") or "mlp"
-    # The train_loop child composes axes over the 8-virtual-device mesh;
-    # a bare smoke host may expose only one CPU device.
-    extra = _cpu_virtual_env() if config == "train_loop" else None
+    # The train_loop/autotune children compose axes over the
+    # 8-virtual-device mesh; a bare smoke host may expose only one CPU
+    # device.
+    extra = (
+        _cpu_virtual_env() if config in ("train_loop", "autotune") else None
+    )
     result = _run_child(config, 240.0, "cpu", extra)
     if result is None:
         result = {"metric": "bench_failed", "value": 0.0, "unit": "none",
@@ -1867,14 +1960,16 @@ def main() -> None:
         # compile-heavy as resnet50 on a cold cache: same 900 s.
         child_to = float(timeout_override) if timeout_override else {
             **dict(_CONFIGS), "unet": 900.0, "train_loop": 240.0,
+            "autotune": 300.0,
         }.get(forced, 300.0)
-        # The train_loop child composes axes — on a CPU target a bare
-        # host may expose one device, so give it the 8-virtual-device
-        # mesh (same treatment as the smoke path; a TPU target keeps
-        # its real devices).
+        # The train_loop/autotune children compose axes — on a CPU
+        # target a bare host may expose one device, so give them the
+        # 8-virtual-device mesh (same treatment as the smoke path; a
+        # TPU target keeps its real devices).
         extra = (
             _cpu_virtual_env()
-            if forced == "train_loop" and platform in (None, "cpu")
+            if forced in ("train_loop", "autotune")
+            and platform in (None, "cpu")
             else None
         )
         result = _run_child(forced, child_to, platform, extra)
@@ -1989,6 +2084,17 @@ def main() -> None:
         axes = _run_axis_bench(remaining())
         if axes is not None:
             result["parallel_axes"] = axes
+    if remaining() > 150 and result["metric"] != "bench_failed":
+        # Layout autotuner over the same CPU virtual mesh: the full
+        # enumerate→prune→trial→bank record banks next to the per-axis
+        # legs so the winner can be audited against the hand-picked
+        # layouts above.
+        at_rec = _run_child(
+            "autotune", min(300.0, remaining() - 30), "cpu",
+            _cpu_virtual_env(),
+        )
+        if at_rec is not None and "autotune" in at_rec:
+            result["autotune"] = at_rec["autotune"]
 
     _emit_telemetry(result)
     print(json.dumps(result))

@@ -47,3 +47,7 @@ from .train import (  # noqa: F401
     make_window_program,
 )
 from .loop import train_loop  # noqa: F401  (after .train: loop imports it)
+from .autotune import (  # noqa: F401  (after .train/.loop: trials use both)
+    AutotuneResult,
+    autotune,
+)

@@ -885,6 +885,12 @@ def train_loop(
         )
 
     flops_probed = False  # one cost-model probe per run, hit or miss
+    # Per-run window-cache ledger: how many window programs this run
+    # reused vs compiled, and the seconds the compiles cost. Surfaces in
+    # the summary (``window_cache`` / ``window_compile_seconds``) so
+    # bench legs and autotune trials can PROVE a run was a pure cache
+    # hit instead of inferring it from wall clock.
+    window_compile = {"seconds": 0.0, "hits": 0, "misses": 0}
 
     def _window_program(
         width: int, cur_state: Any, staged: Any, perm: Any, avals: tuple
@@ -910,7 +916,7 @@ def train_loop(
             from .train import make_window_program
 
             fn = make_window_program(hot, width=width, lbs=lbs_fused)
-            t0 = time.perf_counter() if cp_on else 0.0
+            t0 = time.perf_counter()
             if gp_on:
                 with gp.segment("compile"):
                     prog = fn.lower(
@@ -920,11 +926,14 @@ def train_loop(
                 prog = fn.lower(
                     cur_state, staged, perm, np.int32(0)
                 ).compile()
+            dt = time.perf_counter() - t0
+            window_compile["misses"] += 1
+            window_compile["seconds"] += dt
             if cp_on:
-                cp.note_aot_compile(
-                    "train_loop.window", time.perf_counter() - t0
-                )
+                cp.note_aot_compile("train_loop.window", dt)
             cache[key] = prog
+        else:
+            window_compile["hits"] += 1
         nonlocal flops_probed
         if gp_on and not flops_probed and gp._flops_per_update is None:
             # FLOPs per update from the window executable's cost model —
@@ -1406,6 +1415,12 @@ def train_loop(
         "dispatches": dispatches,
         "fused_window": fused_w or None,
     }
+    if fused_w:
+        summary["window_compile_seconds"] = window_compile["seconds"]
+        summary["window_cache"] = {
+            "hits": window_compile["hits"],
+            "misses": window_compile["misses"],
+        }
     if gp_on:
         # Final record covers the drain/emergency-save tail the last
         # in-loop flush could not see; the report rides the summary so

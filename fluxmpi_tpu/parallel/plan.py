@@ -293,6 +293,16 @@ class ResolvedPlan:
         self.rule_hits: dict[str, int] = {}
         self._rule = self._build_rule()
         self._state_sharding: Any | None = None
+        # partition_specs memo: (treedef incl. leaf paths, leaf shapes)
+        # → (specs, rule_hits). The rule table is frozen at resolve time
+        # (_build_rule runs once, above), so the plan instance IS the
+        # rule-table identity and per-instance storage needs no table
+        # key. The layout autotuner lays the same state tree out once
+        # per candidate per stage — without the memo every call re-walks
+        # the regex table over every leaf path.
+        self._spec_cache: dict[tuple, tuple[Any, dict[str, int]]] = {}
+        self.spec_cache_hits = 0
+        self.spec_cache_misses = 0
 
     # -- axis queries ---------------------------------------------------
 
@@ -411,9 +421,32 @@ class ResolvedPlan:
         """Map the plan's rule over ``tree`` → validated PartitionSpecs.
         Scalar leaves get ``P()``; unmatched non-scalar leaves raise
         under ``strict=True`` (no silent replication), otherwise count
-        into ``rule_hits["replicated"]``."""
+        into ``rule_hits["replicated"]``.
+
+        Memoized per (treedef, leaf shapes): the treedef carries the
+        leaf paths the regex table matches on, the shapes carry the
+        divisibility checks, and the rule table is frozen at resolve
+        time — so a repeat of both is byte-identical. A cache hit
+        restores that application's ``rule_hits`` too (the board's
+        last-tree contract holds either way). Degradation warnings fire
+        only on the miss — callers that CAPTURE warnings (the layout
+        autotuner's enumerate stage) lay each fresh plan out exactly
+        once, which is always a miss."""
         mesh = self.mesh
         strict = self.config.strict
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        key = (
+            treedef,
+            tuple(
+                tuple(getattr(leaf, "shape", ()) or ()) for leaf in leaves
+            ),
+        )
+        cached = self._spec_cache.get(key)
+        if cached is not None:
+            specs, hits = cached
+            self.spec_cache_hits += 1
+            self.rule_hits = dict(hits)
+            return specs
         # Fresh counts per application: the board reports the LAST tree
         # laid out, not a lifetime accumulation (a warmup + timed run
         # pair must not double the "how many leaves each axis claimed"
@@ -441,7 +474,15 @@ class ResolvedPlan:
             hits[source] = hits.get(source, 0) + 1
             return _validated(spec, shape, mesh, path=name)
 
-        return jax.tree_util.tree_map_with_path(leaf_spec, tree)
+        specs = jax.tree_util.tree_map_with_path(leaf_spec, tree)
+        self.spec_cache_misses += 1
+        if len(self._spec_cache) >= 16:
+            # A plan sees a handful of distinct trees (state, params,
+            # grads) — 16 distinct layouts means something is generating
+            # trees; cap the memo rather than grow it unboundedly.
+            self._spec_cache.clear()
+        self._spec_cache[key] = (specs, dict(hits))
+        return specs
 
     def shard_state(self, state: Any) -> tuple[Any, Any]:
         """Lay a :class:`~fluxmpi_tpu.parallel.TrainState` (or any

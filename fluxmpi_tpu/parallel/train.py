@@ -370,7 +370,11 @@ def make_train_step(
         :meth:`~fluxmpi_tpu.parallel.plan.ResolvedPlan.shard_state` to
         have been called first — the banked layout is what the compiled
         step pins; a dp(/sp)-only plan needs nothing banked.
-        ``style="auto"`` only.
+        ``style="auto"`` only. The string ``"auto"`` resolves to the
+        plan the layout autotuner installed under
+        ``init(parallel="auto")`` (raises, naming
+        :func:`fluxmpi_tpu.parallel.autotune.autotune`, when none is
+        installed yet).
       mesh: defaults to the plan's mesh, else the runtime's global mesh.
       axis_name: data-parallel axis (default from the plan, else config).
       style: ``"auto"`` (XLA SPMD partitioner inserts collectives) or
@@ -464,6 +468,26 @@ def make_train_step(
       ``metrics=`` the same signature, instrumented.
     """
     plan = None
+    if isinstance(parallel, str):
+        # parallel="auto": consume the layout the autotuner installed as
+        # the global plan (the init(parallel="auto") contract).
+        if parallel != "auto":
+            raise ValueError(
+                f'parallel= accepts a ParallelConfig, a ResolvedPlan, or '
+                f'the string "auto", got {parallel!r}'
+            )
+        from ..runtime import global_plan as _global_plan
+
+        parallel = _global_plan()
+        if parallel is None:
+            raise ValueError(
+                'make_train_step(parallel="auto") found no installed '
+                "plan — run the layout search first: "
+                "fluxmpi_tpu.parallel.autotune.autotune(loss_fn, "
+                "optimizer, params, sample_batch) under "
+                'init(parallel="auto") installs its winner as the '
+                "global plan (a banked winner is reused without trials)"
+            )
     if parallel is not None:
         if style != "auto":
             raise ValueError(

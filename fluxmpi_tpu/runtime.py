@@ -54,6 +54,7 @@ __all__ = [
     "local_device_count",
     "global_mesh",
     "global_plan",
+    "auto_parallel",
     "dp_axis_name",
     "preemption_requested",
     "request_preemption",
@@ -69,6 +70,10 @@ class _RuntimeState:
     mesh: Mesh | None = None
     plan: Any = None  # the ResolvedPlan behind init(parallel=), if any
     distributed: bool = False
+    # init(parallel="auto") / FLUXMPI_TPU_PARALLEL=auto was requested:
+    # the mesh starts as the dp default and the layout autotuner's
+    # winner is installed over it via _install_autotuned_plan.
+    auto_parallel: bool = False
 
 
 _state = _RuntimeState()
@@ -399,7 +404,13 @@ def init(
         pipeline/ring/ulysses axis-name defaults, checkpoint manifests,
         and the ``/status`` PARALLEL board). Raises
         :class:`~fluxmpi_tpu.errors.TopologyMismatchError` when the
-        plan's axes cannot cover the devices.
+        plan's axes cannot cover the devices. The string ``"auto"``
+        (also reachable via ``FLUXMPI_TPU_PARALLEL=auto`` when neither
+        ``parallel=`` nor ``mesh_shape=`` is passed) arms the layout
+        autotuner instead: the mesh comes up as the dp default and
+        :func:`fluxmpi_tpu.parallel.autotune.autotune` — which needs
+        the model — installs its banked or freshly-trialed winner as
+        the global plan (see docs/performance.md, "Auto layout").
       distributed: force (or forbid) ``jax.distributed.initialize``; default
         auto-detects a pod slice / explicit coordinator.
       coordinator_address, num_processes, process_id: forwarded to
@@ -551,6 +562,32 @@ def init(
     from . import serving as _serving
     from .serving import observe as _serving_observe
 
+    # parallel="auto" (or FLUXMPI_TPU_PARALLEL=auto with no explicit
+    # layout): arm auto mode. The mesh comes up as the 1-D dp default;
+    # fluxmpi_tpu.parallel.autotune.autotune(...) later installs its
+    # winner over it (same-process, pre-training) — init itself cannot
+    # run trials because it does not know the model yet.
+    auto_requested = False
+    if isinstance(parallel, str):
+        if parallel != "auto":
+            raise ValueError(
+                f'parallel= accepts a ParallelConfig, a ResolvedPlan, or '
+                f'the string "auto", got {parallel!r}'
+            )
+        auto_requested = True
+        parallel = None
+    elif parallel is None and mesh_shape is None:
+        env_parallel = os.environ.get("FLUXMPI_TPU_PARALLEL", "").strip()
+        if env_parallel == "auto":
+            auto_requested = True
+        elif env_parallel:
+            warnings.warn(
+                f'ignoring FLUXMPI_TPU_PARALLEL={env_parallel!r} — the '
+                f'only supported value is "auto" (pass a ParallelConfig '
+                f'to init(parallel=) for an explicit layout)',
+                stacklevel=2,
+            )
+
     if _state.initialized:
         if parallel is not None and not _same_plan(parallel, _state.plan):
             # The mesh (and any installed plan) is frozen at first init:
@@ -581,6 +618,8 @@ def init(
         _serving.configure(serving)
         _serving_observe.configure(request_log)
         _fleet.configure(fleet)
+        if auto_requested:
+            _state.auto_parallel = True
         if verbose:
             fluxmpi_println("fluxmpi_tpu already initialized; skipping...")
         assert _state.mesh is not None
@@ -665,6 +704,7 @@ def init(
         _state.plan = None
     _state.mesh = mesh
     _state.initialized = True
+    _state.auto_parallel = auto_requested
     _configure_telemetry(telemetry)
     _tracing.configure(trace)
     _watchdog.configure(watchdog)
@@ -742,6 +782,7 @@ def shutdown() -> None:
     _state.initialized = False
     _state.mesh = None
     _state.plan = None
+    _state.auto_parallel = False
 
 
 def _require_init() -> None:
@@ -803,6 +844,29 @@ def global_plan() -> Any:
     (pipeline/ring/ulysses axis-name defaults, checkpoint manifests)
     fall back to the ``*_axis_name`` preferences when no plan exists."""
     return _state.plan
+
+
+def auto_parallel() -> bool:
+    """Was the runtime armed with ``init(parallel="auto")`` (or
+    ``FLUXMPI_TPU_PARALLEL=auto``)? While True and no autotuned plan is
+    installed yet, :func:`global_plan` is still None — the layout
+    autotuner fills it in."""
+    return _state.initialized and _state.auto_parallel
+
+
+def _install_autotuned_plan(plan: Any) -> bool:
+    """Install the layout autotuner's winning plan as the global plan
+    (and its mesh as the global mesh). Only honored under an armed auto
+    mode on an initialized runtime — a hand-pinned init must never have
+    its layout swapped out from under it. Returns True when installed."""
+    if not _state.initialized or not _state.auto_parallel:
+        return False
+    from .parallel.plan import post_board
+
+    _state.mesh = plan.mesh
+    _state.plan = plan
+    post_board(plan)
+    return True
 
 
 def dp_axis_name() -> str:

@@ -369,6 +369,7 @@ class Exporter:
         self._model: dict[str, Any] = {}
         self._parallel: dict[str, Any] = {}
         self._fleet: dict[str, Any] = {}
+        self._autotune: dict[str, Any] = {}
         self._status_lock = threading.Lock()
         # Progress plateau tracking (the watchdog's check() shape,
         # evaluated lazily per health request instead of on a poll
@@ -501,6 +502,18 @@ class Exporter:
             self._fleet.update(fields)
             self._fleet["noted_unix"] = time.time()
 
+    def note_autotune(self, **fields: Any) -> None:
+        """Merge ``fields`` into the ``autotune`` section of ``/status``
+        — the AUTOTUNE board (winning axes, candidate/prune/trial
+        census, best trial throughput, bank hit vs fresh tune, the
+        model fingerprint keying the bank), posted by
+        ``parallel/autotune.autotune`` when a search completes or a
+        banked winner is reused. ``scripts/fluxmpi_top.py`` renders it
+        as the AUTOTUNE view."""
+        with self._status_lock:
+            self._autotune.update(fields)
+            self._autotune["noted_unix"] = time.time()
+
     def clear_status(self) -> None:
         with self._status_lock:
             self._status.clear()
@@ -508,6 +521,7 @@ class Exporter:
             self._model.clear()
             self._parallel.clear()
             self._fleet.clear()
+            self._autotune.clear()
 
     # -- health --------------------------------------------------------
 
@@ -604,6 +618,7 @@ class Exporter:
             model = dict(self._model) or None
             parallel = dict(self._parallel) or None
             fleet = dict(self._fleet) or None
+            autotune = dict(self._autotune) or None
         gp = _goodput.get_goodput_tracker()
         goodput_rep = gp.report() if gp.enabled else None
         det = _anomaly.get_anomaly_detector()
@@ -637,6 +652,7 @@ class Exporter:
             "model": model,
             "parallel": parallel,
             "fleet": fleet,
+            "autotune": autotune,
             "goodput": goodput_rep,
             "anomaly": last_anomaly,
             "monitor": monitor,

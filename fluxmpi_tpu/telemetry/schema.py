@@ -93,6 +93,23 @@ FLEET_SCHEMA = "fluxmpi_tpu.fleet/v1"
 # else ``compute`` (the step itself is slow).
 STRAGGLER_CAUSES = ("desync", "data_stall", "comm_wait", "compute")
 
+# Layout-autotuner records (parallel/autotune.py): the banked winner +
+# full candidate table one ``autotune()`` run produces — written as the
+# ``FLUXMPI_TPU_AUTOTUNE_BANK`` file, as the ``<ckpt>.autotune.json``
+# sidecar next to the checkpoint manifest, and embedded in bench
+# records under the ``autotune`` key. A later run with the same (model
+# fingerprint, topology) trusts this record INSTEAD of re-running
+# trials, so ``scripts/check_metrics_schema.py`` validates it like any
+# other cross-run contract.
+AUTOTUNE_SCHEMA = "fluxmpi_tpu.autotune/v1"
+
+# Why a candidate layout was eliminated before trials, in stage order:
+# the static memory model put it over the per-device byte budget
+# (``memory``), or another candidate was no worse on both the static
+# cost score and the memory floor / it fell past the trial budget
+# (``dominated``). A null ``pruned`` means the candidate ran a trial.
+AUTOTUNE_PRUNE_REASONS = ("memory", "dominated")
+
 METRIC_TYPES = ("counter", "gauge", "histogram")
 
 _HIST_STAT_KEYS = ("sum", "min", "max", "mean", "last")
@@ -254,6 +271,17 @@ KNOWN_METRIC_NAMES = frozenset(
         "fleet.step_time_skew",
         "fleet.collective_skew_seconds",
         "fleet.flight_seq_lag",
+        # Layout autotuner (parallel/autotune.py): the last search's
+        # candidate census — enumerated total, per-reason prune counts
+        # ({reason=...}, AUTOTUNE_PRUNE_REASONS), how many survivors
+        # ran fused-window trials and their total wall seconds — plus
+        # the cumulative bank-hit counter (a hit means a tune was
+        # skipped entirely).
+        "autotune.candidates_total",
+        "autotune.pruned",
+        "autotune.trials",
+        "autotune.trial_seconds",
+        "autotune.bank_hits",
     }
 )
 
@@ -269,6 +297,7 @@ _CLOSED_NAMESPACES = (
     "model.",
     "parallel.",
     "fleet.",
+    "autotune.",
 )
 
 # Histogram bucket edges, declared HERE so the registry (which bins
@@ -372,6 +401,12 @@ _BENCH_OPTIONAL: dict[str, tuple[type, ...]] = {
     # virtual mesh.
     "parallel": (dict,),
     "parallel_axes": (dict,),
+    # Layout autotuner (parallel/autotune.py): the full
+    # fluxmpi_tpu.autotune/v1 record of the bench's auto-layout leg —
+    # candidate table with static scores and trial throughputs, winner,
+    # bank identity. Validated as an embedded autotune record by
+    # validate_bench_record when it carries the schema tag.
+    "autotune": (dict,),
 }
 
 
@@ -518,6 +553,11 @@ def validate_bench_record(rec: object) -> list[str]:
             )
     if "mfu" in rec and _is_number(rec["mfu"]) and not 0 <= rec["mfu"] <= 1:
         errors.append(f"'mfu' out of range [0, 1]: {rec['mfu']!r}")
+    at = rec.get("autotune")
+    if isinstance(at, dict) and at.get("schema") == AUTOTUNE_SCHEMA:
+        errors.extend(
+            f"autotune: {e}" for e in validate_autotune_record(at)
+        )
     return errors
 
 
@@ -546,7 +586,15 @@ def validate_status_record(rec: object) -> list[str]:
     for key in ("train", "monitor", "watchdog"):
         if not isinstance(rec.get(key), dict):
             errors.append(f"'{key}' must be an object")
-    for key in ("goodput", "anomaly", "serving", "model", "parallel", "fleet"):
+    for key in (
+        "goodput",
+        "anomaly",
+        "serving",
+        "model",
+        "parallel",
+        "fleet",
+        "autotune",
+    ):
         v = rec.get(key)
         if v is not None and not isinstance(v, dict):
             errors.append(f"'{key}' must be null or an object")
@@ -710,6 +758,156 @@ def validate_fleet_snapshot(rec: object) -> list[str]:
                 errors.append(
                     f"stragglers[{cause!r}]: count must be an int >= 0"
                 )
+    return errors
+
+
+def validate_autotune_record(rec: object) -> list[str]:
+    """Validate one layout-autotuner record (schema
+    "fluxmpi_tpu.autotune/v1", produced by
+    ``parallel/autotune.autotune`` — the bank file, the checkpoint
+    sidecar, and the bench's embedded ``autotune`` block all carry the
+    same shape); returns a list of error strings (empty == valid).
+
+    The internal consistency rules ARE the bank contract: a ``pruned``
+    candidate (reason in AUTOTUNE_PRUNE_REASONS) must carry no trial, an
+    unpruned one must carry its trial evidence, ``trials`` must equal
+    the unpruned count, and the ``winner`` must be one of the trialed
+    candidates — a record violating any of these was not produced by a
+    completed search and must not short-circuit one."""
+    if not isinstance(rec, dict):
+        return [f"autotune record is not an object: {type(rec).__name__}"]
+    errors: list[str] = []
+    if rec.get("schema") != AUTOTUNE_SCHEMA:
+        errors.append(
+            f"'schema' must be {AUTOTUNE_SCHEMA!r}, got {rec.get('schema')!r}"
+        )
+    if not _is_number(rec.get("time_unix")):
+        errors.append("missing numeric 'time_unix'")
+    fp = rec.get("model_fingerprint")
+    if not isinstance(fp, str) or not fp:
+        errors.append("missing/invalid 'model_fingerprint' (non-empty str)")
+    topo = rec.get("topology")
+    if not isinstance(topo, dict):
+        errors.append("'topology' must be an object")
+    else:
+        nd = topo.get("n_devices")
+        if not isinstance(nd, int) or isinstance(nd, bool) or nd < 1:
+            errors.append("topology: 'n_devices' must be an int >= 1")
+        if not isinstance(topo.get("device_kind"), str) or not topo.get(
+            "device_kind"
+        ):
+            errors.append(
+                "topology: 'device_kind' must be a non-empty str"
+            )
+        pc = topo.get("process_count")
+        if not isinstance(pc, int) or isinstance(pc, bool) or pc < 1:
+            errors.append("topology: 'process_count' must be an int >= 1")
+    fsdp_min = rec.get("fsdp_min_size")
+    if not isinstance(fsdp_min, int) or isinstance(fsdp_min, bool) or (
+        fsdp_min < 0
+    ):
+        errors.append("'fsdp_min_size' must be an int >= 0")
+
+    def _axes_ok(axes: object, where: str) -> bool:
+        if not isinstance(axes, dict) or not axes:
+            errors.append(f"{where}: 'axes' must be a non-empty object")
+            return False
+        ok = True
+        for axis, size in axes.items():
+            if not isinstance(axis, str) or not axis:
+                errors.append(f"{where}: axes keys must be non-empty str")
+                ok = False
+            if not isinstance(size, int) or isinstance(size, bool) or (
+                size < 1
+            ):
+                errors.append(
+                    f"{where}: axes[{axis!r}] must be an int >= 1"
+                )
+                ok = False
+        return ok
+
+    winner = rec.get("winner")
+    winner_axes = None
+    if not isinstance(winner, dict):
+        errors.append("'winner' must be an object")
+    else:
+        if _axes_ok(winner.get("axes"), "winner"):
+            winner_axes = winner.get("axes")
+        names = winner.get("axis_names")
+        if not isinstance(names, dict) or not all(
+            isinstance(k, str) and isinstance(v, str) and k and v
+            for k, v in names.items()
+        ):
+            errors.append(
+                "winner: 'axis_names' must map non-empty str -> str"
+            )
+    trials = rec.get("trials")
+    if not isinstance(trials, int) or isinstance(trials, bool) or trials < 1:
+        errors.append("'trials' must be an int >= 1")
+    cands = rec.get("candidates")
+    trialed = 0
+    winner_trialed = False
+    if not isinstance(cands, list) or not cands:
+        errors.append("'candidates' must be a non-empty list")
+    else:
+        for i, cand in enumerate(cands):
+            where = f"candidates[{i}]"
+            if not isinstance(cand, dict):
+                errors.append(f"{where}: must be an object")
+                continue
+            _axes_ok(cand.get("axes"), where)
+            for key in ("mem_bytes_per_device", "score"):
+                v = cand.get(key)
+                if v is not None and (not _is_number(v) or v < 0):
+                    errors.append(
+                        f"{where}: {key!r} must be null or a number >= 0"
+                    )
+            pruned = cand.get("pruned")
+            trial = cand.get("trial")
+            if pruned is not None:
+                if pruned not in AUTOTUNE_PRUNE_REASONS:
+                    errors.append(
+                        f"{where}: 'pruned' must be null or one of "
+                        f"{AUTOTUNE_PRUNE_REASONS}, got {pruned!r}"
+                    )
+                if trial is not None:
+                    errors.append(
+                        f"{where}: a pruned candidate must carry no "
+                        f"'trial' (got one — prune/trial disagree)"
+                    )
+                continue
+            trialed += 1
+            if not isinstance(trial, dict):
+                errors.append(
+                    f"{where}: an unpruned candidate must carry its "
+                    f"'trial' evidence object"
+                )
+                continue
+            for key in ("examples_per_sec", "compile_seconds", "seconds"):
+                v = trial.get(key)
+                if not _is_number(v) or v < 0:
+                    errors.append(
+                        f"{where}: trial {key!r} must be a number >= 0"
+                    )
+            sc = trial.get("steady_compiles")
+            if not isinstance(sc, int) or isinstance(sc, bool) or sc < 0:
+                errors.append(
+                    f"{where}: trial 'steady_compiles' must be an "
+                    f"int >= 0"
+                )
+            if winner_axes is not None and cand.get("axes") == winner_axes:
+                winner_trialed = True
+        if isinstance(trials, int) and not isinstance(trials, bool) and (
+            trials != trialed
+        ):
+            errors.append(
+                f"'trials' is {trials} but {trialed} candidate(s) carry "
+                f"trial evidence"
+            )
+        if winner_axes is not None and not winner_trialed:
+            errors.append(
+                "'winner' axes match no trialed (unpruned) candidate"
+            )
     return errors
 
 
@@ -877,6 +1075,15 @@ def validate_manifest(rec: object) -> list[str]:
                 errors.append(
                     "parallel: 'axis_names' must map plan axis -> mesh "
                     "axis name"
+                )
+            fp = parallel.get("autotune_fingerprint")
+            if fp is not None and (not isinstance(fp, str) or not fp):
+                # Present only when the layout autotuner picked this
+                # plan: the model fingerprint keying its banked record
+                # (the <ckpt>.autotune.json sidecar carries the table).
+                errors.append(
+                    "parallel: 'autotune_fingerprint' must be null or a "
+                    "non-empty str"
                 )
     return errors
 

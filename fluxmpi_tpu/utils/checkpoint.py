@@ -616,6 +616,15 @@ def _save_checkpoint_body(
                 f"unaffected",
                 stacklevel=2,
             )
+        # When the installed plan is the layout autotuner's winner, its
+        # banked evidence rides next to the manifest (<path>.autotune
+        # .json) — best-effort for the same stranded-peer reason.
+        try:
+            from ..parallel.autotune import write_bank_sidecar
+
+            write_bank_sidecar(path)
+        except Exception:
+            pass
         if _faults.ARMED:
             # The crash-between-rename-and-commit window, injectable.
             _faults.check("ckpt.commit")

@@ -20,6 +20,7 @@ from typing import Any
 __all__ = [
     "chip_peak_flops",
     "cost_analysis_flops",
+    "executable_cost",
     "executable_flops",
     "mfu",
     "PEAK_FLOPS",
@@ -61,6 +62,38 @@ def executable_flops(compiled: Any) -> float | None:
         if analysis:
             flops = float(analysis.get("flops", 0.0))
             return flops if flops > 0 else None
+    except Exception:
+        pass
+    return None
+
+
+def executable_cost(compiled: Any) -> dict[str, float] | None:
+    """FLOPs AND bytes-accessed per call of an already-compiled
+    executable — :func:`executable_flops` grown with the memory-traffic
+    term the layout autotuner's static score needs (an all-gather the
+    partitioner inserted shows up as bytes accessed, not FLOPs).
+    Returns ``{"flops": ..., "bytes_accessed": ...}`` with absent /
+    non-positive entries as 0.0, or None when the backend exposes no
+    cost model at all."""
+    try:
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0] if analysis else None
+        if analysis:
+            flops = float(analysis.get("flops", 0.0) or 0.0)
+            accessed = float(analysis.get("bytes accessed", 0.0) or 0.0)
+            if not accessed:
+                # Some backends report only the split per-operand form
+                # ("bytes accessed operand N{}", "bytes accessed output").
+                accessed = sum(
+                    float(v or 0.0)
+                    for k, v in analysis.items()
+                    if isinstance(k, str) and k.startswith("bytes accessed")
+                )
+            return {
+                "flops": max(flops, 0.0),
+                "bytes_accessed": max(accessed, 0.0),
+            }
     except Exception:
         pass
     return None

@@ -231,6 +231,13 @@ def build_manifest(
                     "axes": desc["axes"],
                     "axis_names": desc["axis_names"],
                 }
+                fp = getattr(plan, "autotune_fingerprint", None)
+                if fp:
+                    # The layout autotuner picked this plan: record the
+                    # bank key so a restore knows which banked record
+                    # (the <ckpt>.autotune.json sidecar) vouches for
+                    # the layout it is rebuilding.
+                    parallel["autotune_fingerprint"] = str(fp)
     except Exception:
         parallel = None
     counters = _int_section(state, "loop")

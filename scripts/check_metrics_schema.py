@@ -14,8 +14,10 @@ Usage:
   and lines carrying ``"schema": "fluxmpi_tpu.fleet/v1"`` (the
   :class:`FleetCollector`'s per-interval snapshot bank,
   ``init(fleet=...)`` / ``FLUXMPI_TPU_FLEET``), which validate as
-  fleet snapshots — and a line carrying a ``bench`` key
-  must also embed a valid bench record. Metric names in the
+  fleet snapshots, and lines carrying
+  ``"schema": "fluxmpi_tpu.autotune/v1"`` (layout-autotuner records),
+  which validate as autotune records — and a line carrying a ``bench``
+  key must also embed a valid bench record. Metric names in the
   framework-owned ``fault.`` / ``checkpoint.`` / ``goodput.`` /
   ``anomaly.`` / ``compile.`` / ``memory.`` namespaces must come from
   ``schema.KNOWN_METRIC_NAMES``
@@ -41,6 +43,10 @@ Usage:
   (the ``<step>.manifest.json`` topology sidecar every checkpoint save
   writes): validated against the manifest schema — leaf
   shapes/dtypes/partition specs, mesh axes, loader geometry.
+- ``*.json`` files carrying ``"schema": "fluxmpi_tpu.autotune/v1"``
+  (the ``FLUXMPI_TPU_AUTOTUNE_BANK`` file or a ``<ckpt>.autotune.json``
+  sidecar): validated as layout-autotuner records — candidate table
+  consistency (pruned ⇒ no trial, trials count, winner trialed).
 - other ``*.json`` files: a bench record — either bench.py's raw output
   (``{"metric": ...}``) or a driver BENCH_*.json wrapper whose ``tail``
   holds the JSON line bench.py printed.
@@ -131,6 +137,16 @@ def check_file(path: str, schema) -> list[str]:
                 for e in schema.validate_fleet_snapshot(rec):
                     errors.append(f"{path}:{i}: {e}")
                 continue
+            if (
+                isinstance(rec, dict)
+                and rec.get("schema") == schema.AUTOTUNE_SCHEMA
+            ):
+                # Layout-autotuner record appended to a JSONL stream
+                # (e.g. a bank of tunes) — the same shape as the
+                # FLUXMPI_TPU_AUTOTUNE_BANK file.
+                for e in schema.validate_autotune_record(rec):
+                    errors.append(f"{path}:{i}: {e}")
+                continue
             for e in schema.validate_record(rec):
                 errors.append(f"{path}:{i}: {e}")
             if isinstance(rec, dict) and "bench" in rec:
@@ -152,6 +168,14 @@ def check_file(path: str, schema) -> list[str]:
         # A single fleet snapshot saved as .json (FleetCollector
         # .snapshot() dumped whole rather than banked line-by-line).
         return [f"{path}: {e}" for e in schema.validate_fleet_snapshot(data)]
+    if isinstance(data, dict) and data.get("schema") == schema.AUTOTUNE_SCHEMA:
+        # A layout-autotuner bank file (FLUXMPI_TPU_AUTOTUNE_BANK) or a
+        # <ckpt>.autotune.json sidecar: the banked winner + candidate
+        # table a later init(parallel="auto") trusts instead of
+        # re-running trials.
+        return [
+            f"{path}: {e}" for e in schema.validate_autotune_record(data)
+        ]
     rec = _bench_record_from(data) if isinstance(data, dict) else None
     if rec is None:
         # A wrapper with no bench line is a bench that never ran — not a

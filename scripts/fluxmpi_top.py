@@ -369,6 +369,47 @@ def _parallel_rows(statuses: dict[str, Any]) -> list[str]:
     return rows
 
 
+def _autotune_rows(statuses: dict[str, Any]) -> list[str]:
+    """The AUTOTUNE block: one row per host whose ``/status`` carries an
+    ``autotune`` board (``parallel/autotune.autotune`` posts it when a
+    layout search completes or a banked winner is reused) — the winning
+    axes, the enumerate/prune/trial census, the best trial throughput,
+    and whether the bank answered (``hit``) or trials ran (``tuned``)."""
+    rows: list[str] = []
+    for name, status in statuses.items():
+        board = (status or {}).get("autotune")
+        if not isinstance(board, dict):
+            continue
+        if not rows:
+            rows.append(
+                f"{'AUTOTUNE':<18}{'CAND':>5} {'PRUNED':>7} {'TRIALS':>7}"
+                "  WINNER / BANK"
+            )
+        winner = board.get("winner")
+        winner_str = "-"
+        if isinstance(winner, dict) and winner:
+            winner_str = "x".join(
+                f"{axis}:{size}"
+                for axis, size in winner.items()
+                if isinstance(size, int) and size > 1
+            ) or "dp:1"
+        pruned = (board.get("pruned_memory") or 0) + (
+            board.get("pruned_dominated") or 0
+        )
+        detail = f"{winner_str} [{board.get('bank', '?')}]"
+        eps = board.get("best_examples_per_sec")
+        if isinstance(eps, (int, float)):
+            detail += f" {eps:.1f} ex/s"
+        rows.append(
+            f"{name:<18}"
+            f"{_fmt(board.get('candidates'), '>5.0f'):>5} "
+            f"{pruned:>7} "
+            f"{_fmt(board.get('trials'), '>7.0f'):>7}  "
+            f"{detail}"
+        )
+    return rows
+
+
 def _fleet_rows(statuses: dict[str, Any]) -> list[str]:
     """The FLEET block: one row per host whose ``/status`` carries the
     cross-host collector's verdict board (the ``fleet`` section with a
@@ -460,6 +501,7 @@ def render_frame(
     lines.append("anomalies:" + (" (none)" if not tickers else ""))
     lines.extend(tickers)
     lines.extend(_parallel_rows(statuses))
+    lines.extend(_autotune_rows(statuses))
     lines.extend(_model_rows(statuses))
     lines.extend(_serving_rows(statuses, rates))
     lines.extend(_fleet_rows(statuses))

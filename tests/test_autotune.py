@@ -1,0 +1,669 @@
+"""Layout-autotuner tests (parallel/autotune.py): the four-stage search
+on the 8-virtual-device CPU mesh — enumerate, static prune (memory model
+oracle + AOT cost ranking), fused-window trials with zero steady-state
+retraces, and the bank contract (same model+topology → zero trials;
+topology change → re-tune) — plus the plan spec-cache memoization and
+the ``parallel="auto"`` wiring through init/make_train_step."""
+
+import contextlib
+import json
+import sys
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+# The package re-exports the autotune FUNCTION from parallel/__init__,
+# which shadows the submodule on attribute access — bind the module
+# object itself.
+import fluxmpi_tpu.parallel.autotune  # noqa: F401
+
+at = sys.modules["fluxmpi_tpu.parallel.autotune"]
+
+
+@contextlib.contextmanager
+def _fresh_runtime():
+    """Swap the runtime out so a test can init() its own auto/plan world
+    and hand the session fixture's world back untouched (the test_plan
+    pattern, extended with the auto_parallel slot)."""
+    from fluxmpi_tpu import runtime
+
+    saved = (
+        runtime._state.initialized,
+        runtime._state.mesh,
+        runtime._state.plan,
+        runtime._state.auto_parallel,
+    )
+    runtime._state.initialized = False
+    runtime._state.mesh = None
+    runtime._state.plan = None
+    runtime._state.auto_parallel = False
+    try:
+        yield
+    finally:
+        (
+            runtime._state.initialized,
+            runtime._state.mesh,
+            runtime._state.plan,
+            runtime._state.auto_parallel,
+        ) = saved
+
+
+# A transformer-shaped parameter tree (q/k/v/o + ff kernels) so the
+# Megatron tp rules and the ZeRO fsdp rule both have leaves to claim.
+_D, _FF, _VOCAB = 32, 64, 64
+
+
+def _tiny_params():
+    rng = np.random.default_rng(0)
+
+    def mk(*shape):
+        return jnp.asarray(rng.normal(size=shape, scale=0.02), jnp.float32)
+
+    return {
+        "embed": {"embedding": mk(_VOCAB, _D)},
+        "layer0": {
+            "attn": {
+                "q": {"kernel": mk(_D, _D)},
+                "k": {"kernel": mk(_D, _D)},
+                "v": {"kernel": mk(_D, _D)},
+                "o": {"kernel": mk(_D, _D)},
+            },
+            "ff1": {"kernel": mk(_D, _FF)},
+            "ff2": {"kernel": mk(_FF, _D)},
+        },
+    }
+
+
+def _loss_fn(p, mstate, batch):
+    x = p["embed"]["embedding"][batch["x"]]
+    a = p["layer0"]
+    h = x @ a["attn"]["q"]["kernel"] @ a["attn"]["o"]["kernel"].T
+    h = jax.nn.relu(h @ a["ff1"]["kernel"]) @ a["ff2"]["kernel"]
+    logits = h @ p["embed"]["embedding"].T
+    loss = optax.softmax_cross_entropy_with_integer_labels(
+        logits, batch["y"]
+    ).mean()
+    return loss, mstate
+
+
+def _batch(gbs=16, seq=8):
+    rng = np.random.default_rng(1)
+    return {
+        "x": np.asarray(rng.integers(0, _VOCAB, (gbs, seq)), np.int32),
+        "y": np.asarray(rng.integers(0, _VOCAB, (gbs, seq)), np.int32),
+    }
+
+
+def _fake_trial(eps_fn):
+    """A deterministic _run_trial stand-in: throughput is a pure function
+    of the candidate's axes — no compile, no execution."""
+
+    def fake(loss_fn, optimizer, host_params, model_state, sample_batch,
+             plan, *, window, epochs, seed):
+        # plan.sizes omits size-1 axes — normalize for the eps function.
+        axes = {a: plan.sizes.get(a, 1) for a in ("dp", "fsdp", "tp")}
+        return {
+            "examples_per_sec": float(eps_fn(axes)),
+            "updates": window * epochs,
+            "compile_seconds": 0.01,
+            "steady_compiles": 0,
+            "retraces": 0,
+            "seconds": 0.02,
+        }
+
+    return fake
+
+
+# ---------------------------------------------------------------------------
+# Stage 1: enumeration rides the strict plan path
+# ---------------------------------------------------------------------------
+
+
+def test_enumerate_candidates_covers_factorizations(world):
+    cands = at.enumerate_candidates(
+        _tiny_params(), jax.devices(), fsdp_min_size=256
+    )
+    axes = [tuple(c.axes[a] for a in ("dp", "fsdp", "tp")) for c in cands]
+    # 8 devices → 10 ordered dp×fsdp×tp factorizations, dp descending;
+    # every one is valid for this model (tp divides every matched dim,
+    # fsdp has leaves ≥ 256 elements to claim).
+    assert len(axes) == 10
+    assert axes[0] == (8, 1, 1)  # pure dp first
+    assert all(d * f * t == 8 for d, f, t in axes)
+    assert len(set(axes)) == 10
+
+
+def test_enumerate_drops_tp_that_cannot_divide(world):
+    # d_model=6: tp=4 cannot divide any matched dim, so every tp=4
+    # layout must be dropped (the strict rule engine warns → invalid).
+    rng = np.random.default_rng(0)
+    params = {
+        "attn": {
+            "q": {"kernel": jnp.asarray(rng.normal(size=(6, 6)), jnp.float32)},
+            "o": {"kernel": jnp.asarray(rng.normal(size=(6, 6)), jnp.float32)},
+        },
+    }
+    cands = at.enumerate_candidates(params, jax.devices(), fsdp_min_size=1)
+    assert cands, "some layout must survive"
+    assert all(c.axes["tp"] not in (4, 8) for c in cands)
+
+
+# ---------------------------------------------------------------------------
+# Stage 2: the static memory model (oracle) and the prune verdict
+# ---------------------------------------------------------------------------
+
+
+def test_tree_bytes_per_device_oracle(world):
+    from fluxmpi_tpu import ParallelConfig
+
+    plan = ParallelConfig(dp=1, fsdp=8, fsdp_min_size=1).resolve(
+        jax.devices()
+    )
+    leaf = jnp.zeros((8, 16), jnp.float32)  # 512 bytes
+    assert at._tree_bytes_per_device(
+        {"w": leaf}, {"w": P("fsdp", None)}, plan.mesh
+    ) == 512 // 8
+    assert at._tree_bytes_per_device(
+        {"w": leaf}, {"w": P(None, None)}, plan.mesh
+    ) == 512
+    # Non-divisible shard: ceil, never undercount.
+    odd = jnp.zeros((9,), jnp.float32)  # 36 bytes over 8 shards → ceil 5
+    assert at._tree_bytes_per_device(
+        {"w": odd}, {"w": P("fsdp")}, plan.mesh
+    ) == 5
+
+
+def test_layout_bytes_adamw_oracle(world):
+    """Hand-computed floor: params + adamw mu/nu + gradient, fsdp=8 vs
+    replicated — the fsdp layout's floor is ~1/8th (small replicated
+    scalars like the step counter aside)."""
+    from fluxmpi_tpu import ParallelConfig
+
+    params = {"dense": {"kernel": jnp.zeros((64, 64), jnp.float32)}}
+    kbytes = 64 * 64 * 4
+    template = at.state_template(params, optax.adamw(1e-3))
+    flat = ParallelConfig(dp=8).resolve(jax.devices())
+    sharded = ParallelConfig(dp=1, fsdp=8, fsdp_min_size=1).resolve(
+        jax.devices()
+    )
+    b_flat = at.layout_bytes(template, flat)
+    b_shard = at.layout_bytes(template, sharded)
+    # Replicated: kernel + mu + nu + gradient = 4 copies, plus O(bytes)
+    # of scalar counters.
+    assert b_flat >= 4 * kbytes
+    assert b_flat < 4 * kbytes + 1024
+    # fsdp=8 shards all four big trees 8-ways.
+    assert b_shard >= 4 * kbytes // 8
+    assert b_shard < 4 * kbytes // 8 + 1024
+    assert b_shard < b_flat // 4
+
+
+def test_prune_dominated_keeps_pure_dp(world):
+    """Without a memory budget the static score alone ranks — and the
+    pure-dp baseline survives even when it is ranked dead last."""
+    cands = at.enumerate_candidates(
+        _tiny_params(), jax.devices(), fsdp_min_size=256
+    )
+    for c in cands:
+        c.mem_bytes_per_device = 1024
+        # Synthetic score: favour heavy sharding so pure-dp would be
+        # ranked LAST — the forced-inclusion rule must still keep it.
+        c.score = float(c.axes["dp"])
+    survivors = at._prune(cands, bytes_limit=None, max_trials=3)
+    assert len(survivors) == 3
+    assert sum(1 for c in cands if c.pruned == "dominated") == len(cands) - 3
+    assert any(
+        c.axes == {"dp": 8, "fsdp": 1, "tp": 1} for c in survivors
+    )
+
+
+def test_prune_memory_kills_infeasible_even_pure_dp(world):
+    """The real memory model makes fully-replicated pure-dp the biggest
+    layout; a budget below it prunes it ``"memory"`` — forced inclusion
+    never resurrects an infeasible baseline."""
+    cands = at.enumerate_candidates(
+        _tiny_params(), jax.devices(), fsdp_min_size=256
+    )
+    template = at.state_template(_tiny_params(), optax.adamw(1e-3))
+    for c in cands:
+        c.mem_bytes_per_device = at.layout_bytes(template, c.plan)
+        c.score = 1.0
+    mems = sorted(c.mem_bytes_per_device for c in cands)
+    pure = next(c for c in cands if c.axes == {"dp": 8, "fsdp": 1, "tp": 1})
+    assert pure.mem_bytes_per_device == mems[-1]  # replicated = biggest
+    limit = mems[-2]
+    survivors = at._prune(cands, bytes_limit=limit, max_trials=3)
+    assert pure.pruned == "memory"
+    assert pure not in survivors
+    assert all(c.mem_bytes_per_device <= limit for c in survivors)
+    assert sum(1 for c in cands if c.pruned == "memory") >= 1
+
+
+# ---------------------------------------------------------------------------
+# The full search, end to end on the real train_loop (slow-ish: real
+# fused-window trials) — plus the bank contract in the same process.
+# ---------------------------------------------------------------------------
+
+
+def test_autotune_e2e_and_bank_hit(world):
+    from fluxmpi_tpu import runtime
+    from fluxmpi_tpu.parallel import make_train_step
+    from fluxmpi_tpu.telemetry import get_registry
+    from fluxmpi_tpu.telemetry.schema import validate_autotune_record
+
+    at.clear_bank()
+    with _fresh_runtime():
+        import fluxmpi_tpu as fm
+
+        fm.init(parallel="auto", compileplane=True)
+        assert runtime.auto_parallel()
+        res = at.autotune(
+            _loss_fn, optax.adamw(1e-3), _tiny_params(), _batch(),
+            fsdp_min_size=256, window=2, trial_epochs=1, seed=0,
+        )
+        rec = res.record
+        assert not res.from_bank
+        assert validate_autotune_record(rec) == []
+        cands = rec["candidates"]
+        assert len(cands) == 10
+        pruned = [c for c in cands if c["pruned"]]
+        trialed = [c for c in cands if c["trial"]]
+        # ≥50% die statically; at most the default 4 budget run trials.
+        assert len(pruned) >= len(cands) // 2
+        assert 1 <= len(trialed) <= 4
+        assert rec["trials"] == len(trialed)
+        # Pure dp is always among the trials (the baseline to beat).
+        assert any(
+            c["axes"] == {"dp": 8, "fsdp": 1, "tp": 1} for c in trialed
+        )
+        # Steady state is a pure window-cache hit for every trial.
+        for c in trialed:
+            assert c["trial"]["steady_compiles"] == 0
+            assert c["trial"]["retraces"] == 0
+            assert c["trial"]["compile_seconds"] > 0
+        # The winner is the measured-throughput argmax.
+        best = max(trialed, key=lambda c: c["trial"]["examples_per_sec"])
+        assert rec["winner"]["axes"] == best["axes"]
+        # The winning plan is installed: make_train_step(parallel="auto")
+        # resolves it with no further wiring.
+        assert runtime.global_plan() is res.plan
+        assert res.plan.autotune_fingerprint == rec["model_fingerprint"]
+        make_train_step(_loss_fn, optax.adamw(1e-3), parallel="auto")
+        # autotune.* observability landed.
+        reg = get_registry()
+        assert reg.gauge("autotune.candidates_total").value == 10
+        assert reg.gauge("autotune.trials").value == len(trialed)
+
+        # Bank contract: same model + topology → zero trials. Explode on
+        # trial entry to prove none runs.
+        def boom(*a, **k):  # pragma: no cover - must not run
+            raise AssertionError("a trial ran on a bank hit")
+
+        orig = at._run_trial
+        at._run_trial = boom
+        try:
+            res2 = at.autotune(
+                _loss_fn, optax.adamw(1e-3), _tiny_params(), _batch(),
+                fsdp_min_size=256, window=2, trial_epochs=1, seed=0,
+            )
+        finally:
+            at._run_trial = orig
+        assert res2.from_bank
+        assert res2.record["winner"]["axes"] == rec["winner"]["axes"]
+        assert reg.counter("autotune.bank_hits").value >= 1
+    at.clear_bank()
+
+
+def test_autotune_deterministic_pick_and_sidecar(world, tmp_path):
+    """With a deterministic trial stub the pick is a pure function of
+    the candidate table: two forced runs agree, and the winner is the
+    stub's argmax over the trialed set. Also proves the checkpoint
+    sidecar contract."""
+    from fluxmpi_tpu import runtime
+    from fluxmpi_tpu.telemetry.schema import validate_autotune_record
+
+    at.clear_bank()
+    # fsdp buys the most fake throughput; tp second.
+    stub = _fake_trial(
+        lambda axes: 100.0 * axes["fsdp"] + 10.0 * axes["tp"] + axes["dp"]
+    )
+    orig = at._run_trial
+    at._run_trial = stub
+    try:
+        with _fresh_runtime():
+            import fluxmpi_tpu as fm
+
+            fm.init(parallel="auto")
+            kw = dict(fsdp_min_size=256, window=2, trial_epochs=1, seed=0)
+            r1 = at.autotune(
+                _loss_fn, optax.adamw(1e-3), _tiny_params(), _batch(),
+                force=True, **kw,
+            )
+            r2 = at.autotune(
+                _loss_fn, optax.adamw(1e-3), _tiny_params(), _batch(),
+                force=True, **kw,
+            )
+            assert r1.record["winner"]["axes"] == r2.record["winner"]["axes"]
+            trialed = [
+                c for c in r1.record["candidates"] if c["trial"]
+            ]
+            best = max(
+                trialed, key=lambda c: c["trial"]["examples_per_sec"]
+            )
+            assert r1.record["winner"]["axes"] == best["axes"]
+            # Candidate tables are identical across the two forced runs.
+            assert json.dumps(
+                r1.record["candidates"], sort_keys=True
+            ) == json.dumps(r2.record["candidates"], sort_keys=True)
+
+            # Sidecar: written when the installed plan IS the winner…
+            target = str(tmp_path / "ckpt_step10")
+            assert at.write_bank_sidecar(target)
+            with open(target + ".autotune.json") as f:
+                side = json.load(f)
+            assert validate_autotune_record(side) == []
+            assert side["winner"]["axes"] == r1.record["winner"]["axes"]
+        # …and refused once the runtime's plan is no longer that tune's
+        # winner (the fixture world has a plain plan or none).
+        assert not at.write_bank_sidecar(str(tmp_path / "other"))
+    finally:
+        at._run_trial = orig
+        at.clear_bank()
+
+
+def test_autotune_topology_change_retunes(world):
+    """The elastic-resume contract: a different device set misses the
+    bank and re-tunes; returning to the original topology hits it."""
+    at.clear_bank()
+    calls = []
+
+    def counting(loss_fn, optimizer, host_params, model_state,
+                 sample_batch, plan, *, window, epochs, seed):
+        calls.append(dict(plan.sizes))
+        return _fake_trial(lambda axes: float(axes["dp"]))(
+            loss_fn, optimizer, host_params, model_state, sample_batch,
+            plan, window=window, epochs=epochs, seed=seed,
+        )
+
+    orig = at._run_trial
+    at._run_trial = counting
+    try:
+        kw = dict(fsdp_min_size=256, window=2, trial_epochs=1)
+        r8 = at.autotune(
+            _loss_fn, optax.adamw(1e-3), _tiny_params(), _batch(16),
+            devices=jax.devices(), **kw,
+        )
+        n8 = len(calls)
+        assert n8 >= 1 and not r8.from_bank
+        assert r8.record["topology"]["n_devices"] == 8
+
+        r4 = at.autotune(
+            _loss_fn, optax.adamw(1e-3), _tiny_params(), _batch(16),
+            devices=jax.devices()[:4], **kw,
+        )
+        assert not r4.from_bank, "topology change must re-tune"
+        assert len(calls) > n8
+        assert r4.record["topology"]["n_devices"] == 4
+        n_after4 = len(calls)
+
+        back = at.autotune(
+            _loss_fn, optax.adamw(1e-3), _tiny_params(), _batch(16),
+            devices=jax.devices(), **kw,
+        )
+        assert back.from_bank and len(calls) == n_after4
+        assert back.record["winner"]["axes"] == r8.record["winner"]["axes"]
+    finally:
+        at._run_trial = orig
+        at.clear_bank()
+
+
+def test_autotune_file_bank_roundtrip(world, tmp_path):
+    """FLUXMPI_TPU_AUTOTUNE_BANK: the winner survives a process's
+    in-memory bank being dropped (simulated via clear_bank) and is
+    validated before it is trusted."""
+    at.clear_bank()
+    bank = str(tmp_path / "bank.json")
+    orig = at._run_trial
+    at._run_trial = _fake_trial(lambda axes: float(axes["dp"]))
+    try:
+        kw = dict(fsdp_min_size=256, window=2, trial_epochs=1, bank=bank)
+        r1 = at.autotune(
+            _loss_fn, optax.adamw(1e-3), _tiny_params(), _batch(16), **kw
+        )
+        assert not r1.from_bank
+
+        at.clear_bank()  # a "new process": only the file remains
+
+        def boom(*a, **k):  # pragma: no cover - must not run
+            raise AssertionError("trial ran despite a valid file bank")
+
+        at._run_trial = boom
+        r2 = at.autotune(
+            _loss_fn, optax.adamw(1e-3), _tiny_params(), _batch(16), **kw
+        )
+        assert r2.from_bank
+        assert r2.record["winner"]["axes"] == r1.record["winner"]["axes"]
+
+        # A corrupt bank file is ignored (re-tunes instead of crashing).
+        at.clear_bank()
+        with open(bank, "w") as f:
+            f.write("{not json")
+        at._run_trial = _fake_trial(lambda axes: float(axes["dp"]))
+        r3 = at.autotune(
+            _loss_fn, optax.adamw(1e-3), _tiny_params(), _batch(16), **kw
+        )
+        assert not r3.from_bank
+    finally:
+        at._run_trial = orig
+        at.clear_bank()
+
+
+def test_autotune_rejects_indivisible_batch(world):
+    with pytest.raises(ValueError, match="leading dim"):
+        at.autotune(
+            _loss_fn, optax.adamw(1e-3), _tiny_params(), _batch(gbs=12),
+            devices=jax.devices(),
+        )
+
+
+def test_autotune_memory_limit_prunes_and_raises(world):
+    """Explicit bytes_limit drives the memory prune; an impossible limit
+    is a loud error, not a silent OOM-to-be."""
+    params = _tiny_params()
+    template = at.state_template(params, optax.adamw(1e-3))
+    cands = at.enumerate_candidates(params, jax.devices(), fsdp_min_size=256)
+    mems = sorted(
+        at.layout_bytes(template, c.plan) for c in cands
+    )
+    orig = at._run_trial
+    at._run_trial = _fake_trial(lambda axes: float(axes["dp"]))
+    try:
+        limit = mems[len(mems) // 2]  # median: some layouts must die
+        res = at.autotune(
+            _loss_fn, optax.adamw(1e-3), params, _batch(16),
+            devices=jax.devices(), fsdp_min_size=256, bytes_limit=limit,
+            force=True,
+        )
+        rec = res.record
+        assert any(c["pruned"] == "memory" for c in rec["candidates"])
+        for c in rec["candidates"]:
+            if c["trial"]:
+                assert c["mem_bytes_per_device"] <= limit
+        with pytest.raises(RuntimeError, match="does not fit"):
+            at.autotune(
+                _loss_fn, optax.adamw(1e-3), params, _batch(16),
+                devices=jax.devices(), fsdp_min_size=256,
+                bytes_limit=1, force=True,
+            )
+    finally:
+        at._run_trial = orig
+        at.clear_bank()
+
+
+# ---------------------------------------------------------------------------
+# parallel="auto" wiring: init, env var, make_train_step
+# ---------------------------------------------------------------------------
+
+
+def test_init_parallel_auto_arms_runtime(world):
+    from fluxmpi_tpu import runtime
+
+    with _fresh_runtime():
+        import fluxmpi_tpu as fm
+
+        fm.init(parallel="auto")
+        assert runtime.auto_parallel()
+        assert runtime.global_plan() is None  # armed, not yet tuned
+    with _fresh_runtime():
+        import fluxmpi_tpu as fm
+
+        fm.init()
+        assert not runtime.auto_parallel()
+
+
+def test_init_env_var_arms_auto(world, monkeypatch):
+    from fluxmpi_tpu import runtime
+
+    monkeypatch.setenv("FLUXMPI_TPU_PARALLEL", "auto")
+    with _fresh_runtime():
+        import fluxmpi_tpu as fm
+
+        fm.init()
+        assert runtime.auto_parallel()
+
+
+def test_init_rejects_unknown_parallel_string(world):
+    with _fresh_runtime():
+        import fluxmpi_tpu as fm
+
+        with pytest.raises(ValueError, match="auto"):
+            fm.init(parallel="fastest")
+
+
+def test_make_train_step_auto_requires_installed_plan(world):
+    from fluxmpi_tpu.parallel import make_train_step
+
+    with _fresh_runtime():
+        import fluxmpi_tpu as fm
+
+        fm.init(parallel="auto")
+        with pytest.raises(ValueError, match="autotune"):
+            make_train_step(_loss_fn, optax.adamw(1e-3), parallel="auto")
+        with pytest.raises(ValueError, match="auto"):
+            make_train_step(
+                _loss_fn, optax.adamw(1e-3), parallel="fastest"
+            )
+
+
+# ---------------------------------------------------------------------------
+# Satellite: plan partition-spec memoization
+# ---------------------------------------------------------------------------
+
+
+def test_partition_specs_memoized(world):
+    from fluxmpi_tpu import ParallelConfig
+
+    plan = ParallelConfig(dp=4, fsdp=2, fsdp_min_size=256).resolve(
+        jax.devices()
+    )
+    params = _tiny_params()
+    specs1 = plan.partition_specs(params)
+    assert plan.spec_cache_misses == 1
+    assert plan.spec_cache_hits == 0
+    hits1 = dict(plan.rule_hits)
+    specs2 = plan.partition_specs(params)
+    assert plan.spec_cache_hits == 1
+    assert plan.spec_cache_misses == 1
+    assert specs2 is specs1
+    assert plan.rule_hits == hits1  # hit path restores the hit counts
+    # A different tree shape is a different key → a fresh miss.
+    plan.partition_specs({"solo": jnp.zeros((512,), jnp.float32)})
+    assert plan.spec_cache_misses == 2
+    # Same params again: still cached from the first walk.
+    plan.partition_specs(params)
+    assert plan.spec_cache_hits == 2
+
+
+# ---------------------------------------------------------------------------
+# Schema: the autotune/v1 validator
+# ---------------------------------------------------------------------------
+
+
+def _minimal_record():
+    return {
+        "schema": "fluxmpi_tpu.autotune/v1",
+        "time_unix": 1.7e9,
+        "model_fingerprint": "abc123",
+        "topology": {
+            "n_devices": 8, "device_kind": "cpu", "process_count": 1,
+        },
+        "fsdp_min_size": 256,
+        "winner": {"axes": {"dp": 8}, "axis_names": {"dp": "dp"}},
+        "trials": 1,
+        "candidates": [
+            {
+                "axes": {"dp": 8},
+                "mem_bytes_per_device": 1024,
+                "score": 10.0,
+                "pruned": None,
+                "trial": {
+                    "examples_per_sec": 100.0,
+                    "compile_seconds": 0.5,
+                    "steady_compiles": 0,
+                    "seconds": 1.0,
+                },
+            },
+            {
+                "axes": {"dp": 4, "tp": 2},
+                "mem_bytes_per_device": 512,
+                "score": None,
+                "pruned": "dominated",
+                "trial": None,
+            },
+        ],
+    }
+
+
+def test_validate_autotune_record_accepts_minimal(world):
+    from fluxmpi_tpu.telemetry.schema import validate_autotune_record
+
+    assert validate_autotune_record(_minimal_record()) == []
+
+
+@pytest.mark.parametrize(
+    "mutate, needle",
+    [
+        (lambda r: r.update(schema="nope/v0"), "schema"),
+        (lambda r: r.update(trials=2), "trials"),
+        (lambda r: r["winner"].update(axes={"dp": 2}), "winner"),
+        (lambda r: r["candidates"][1].update(pruned="vibes"), "pruned"),
+        (
+            lambda r: r["candidates"][1].update(
+                trial={"examples_per_sec": 1.0, "compile_seconds": 0.0,
+                       "steady_compiles": 0, "seconds": 0.1}
+            ),
+            "pruned",
+        ),
+        (
+            lambda r: r["candidates"][0]["trial"].update(
+                steady_compiles=-1
+            ),
+            "steady_compiles",
+        ),
+        (lambda r: r.update(candidates=[]), "candidates"),
+        (lambda r: r["topology"].update(n_devices=0), "n_devices"),
+    ],
+)
+def test_validate_autotune_record_rejects(world, mutate, needle):
+    from fluxmpi_tpu.telemetry.schema import validate_autotune_record
+
+    rec = _minimal_record()
+    mutate(rec)
+    errors = validate_autotune_record(rec)
+    assert errors, "mutation must be caught"
+    assert any(needle in e for e in errors), errors
