@@ -1413,11 +1413,201 @@ def _bench_serving():
     }
 
 
+def _compiled_memory_bytes(compiled) -> dict | None:
+    """Per-program HBM footprint from XLA's static memory analysis — the
+    per-leg attributable peak (the live ``peak_bytes_in_use`` gauge is a
+    process-lifetime watermark, so an A/B's second leg could never read
+    lower than its first). ``temp_bytes`` is where a dense attend's
+    materialized ``[s, s]`` score tensors live; the flash kernel streams
+    them through VMEM tiles instead."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    out = {}
+    for attr, key in (
+        ("temp_size_in_bytes", "temp_bytes"),
+        ("argument_size_in_bytes", "argument_bytes"),
+        ("output_size_in_bytes", "output_bytes"),
+    ):
+        v = getattr(ma, attr, None)
+        if isinstance(v, (int, float)):
+            out[key] = float(v)
+    return out or None
+
+
+def _bench_attention_ab():
+    """Kernel-plane A/B (ISSUE 19): ``attention="flash"`` vs ``"naive"``
+    through the TransformerLM switch — same model, params, and data per
+    leg; only the attention kernel differs. Both hot paths:
+
+    - **training fwd+bwd**: AOT-compiled adamw step over the fused-CE
+      loss — per-leg samples/sec + the compiled program's static HBM
+      footprint (``memory_analysis``: the dense attend materializes
+      ``[s, s]`` scores in temp space, flash streams tiles) + the
+      steady-state retrace count (must be 0);
+    - **paged serving decode**: ``InferenceEngine`` with continuous
+      batching on a mixed-length workload — per-leg tokens/sec + the
+      steady-state retrace count across mid-flight joins (0 = the
+      no-retrace join contract survives the kernel swap).
+
+    Forced/smoke config (``FLUXMPI_TPU_BENCH_CONFIG=attention_ab``). On
+    CPU the flash legs run the Pallas kernels in interpret mode —
+    correct but emulated, so the speedups are only meaningful on TPU;
+    the retrace and memory accounting holds everywhere."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import fluxmpi_tpu as fm
+    from fluxmpi_tpu.models import TransformerLM
+    from fluxmpi_tpu.serving import InferenceEngine
+    from fluxmpi_tpu.telemetry import compileplane
+
+    devs = _visible_devices()
+    fm.init(devices=devs, compileplane=True)
+    platform = devs[0].platform
+    device_kind = devs[0].device_kind
+    smoke = os.environ.get("FLUXMPI_TPU_BENCH_SMOKE") == "1"
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu and not smoke:
+        # Long-sequence config: where the dense attend's [s, s] scores
+        # dominate temp memory and the flash claim is falsifiable.
+        dims = dict(vocab_size=8192, max_len=2048, num_layers=4,
+                    d_model=512, num_heads=8, d_ff=2048,
+                    dtype=jnp.bfloat16)
+        seq, batch, steps = 2048, 4, 10
+        slots, block, n_requests = 4, 16, 12
+        long_new, short_new = 64, 16
+    else:  # CPU smoke: interpret-mode flash is slow, keep it tiny
+        dims = dict(vocab_size=64, max_len=128, num_layers=2,
+                    d_model=32, num_heads=4, d_ff=64)
+        seq, batch, steps = 128, 2, 3
+        slots, block, n_requests = 2, 8, 4
+        long_new, short_new = 10, 4
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(
+        rng.integers(0, dims["vocab_size"], size=(batch, seq)).astype(np.int32)
+    )
+    y = jnp.asarray(
+        rng.integers(0, dims["vocab_size"], size=(batch, seq)).astype(np.int32)
+    )
+    opt = optax.adamw(1e-4)
+    base = TransformerLM(**dims)
+    params = base.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32), train=False
+    )
+    opt_state = opt.init(params)
+    mon = compileplane.get_compile_monitor()
+
+    def train_leg(mode):
+        model = base.clone(attention=mode)
+
+        def step(p, s, bx, by):
+            def loss_fn(q):
+                return model.apply(q, bx, train=True, targets=by).mean()
+
+            loss, grads = jax.value_and_grad(loss_fn)(p)
+            updates, s2 = opt.update(grads, s, p)
+            return optax.apply_updates(p, updates), s2, loss
+
+        compiled = jax.jit(step).lower(params, opt_state, x, y).compile()
+        mem = _compiled_memory_bytes(compiled)
+        p, s, loss = compiled(params, opt_state, x, y)  # warmup call
+        jax.block_until_ready(loss)
+        mon.observe_flush()  # steady-state boundary for this leg
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            p, s, loss = compiled(p, s, x, y)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        info = mon.observe_flush()
+        leg = {
+            "samples_per_sec": round(batch * steps / dt, 3),
+            "tokens_per_sec": round(batch * seq * steps / dt, 1),
+            "steady_retraces": info["events"],
+        }
+        if mem is not None:
+            leg["compiled_hbm"] = mem
+        return leg
+
+    # One fixed mixed-length workload, shared by both decode legs.
+    workload = []
+    for i in range(n_requests):
+        plen = int(rng.integers(4, 2 * block))
+        max_new = long_new if i % slots == 0 else short_new
+        workload.append(
+            (rng.integers(0, dims["vocab_size"], size=(plen,)).astype(np.int32),
+             max_new)
+        )
+    buckets = tuple(p.shape[0] for p, _ in workload)
+
+    def decode_leg(mode):
+        eng = InferenceEngine(
+            base, params, slots=slots, block_size=block,
+            max_queue=n_requests, continuous=True, attention=mode,
+        )
+        eng.warmup(prompt_lengths=buckets)
+        mon.observe_flush()
+        for prompt, max_new in workload:
+            eng.submit(prompt, max_new)
+        summary = eng.run()
+        info = mon.observe_flush()
+        eng.close()
+        return {
+            "tokens": summary["tokens"],
+            "tokens_per_sec": round(summary["tokens_per_sec"], 1),
+            "steady_retraces": info["events"],
+        }
+
+    train = {m: train_leg(m) for m in ("naive", "flash")}
+    decode = {m: decode_leg(m) for m in ("naive", "flash")}
+
+    def _speedup(legs, key):
+        a = legs["flash"].get(key)
+        b = legs["naive"].get(key)
+        return round(a / b, 3) if a and b else None
+
+    ab = {
+        "seq": seq,
+        "batch": batch,
+        "steps": steps,
+        "train": {**train,
+                  "speedup": _speedup(train, "samples_per_sec")},
+        "decode": {**decode,
+                   "speedup": _speedup(decode, "tokens_per_sec")},
+    }
+    # The directly-asserted memory claim: flash's compiled temp space vs
+    # the dense attend's, when the backend exposes memory_analysis.
+    n_temp = (train["naive"].get("compiled_hbm") or {}).get("temp_bytes")
+    f_temp = (train["flash"].get("compiled_hbm") or {}).get("temp_bytes")
+    if n_temp is not None and f_temp is not None:
+        ab["train"]["hbm_temp_saved_bytes"] = round(n_temp - f_temp, 1)
+
+    value = train["flash"]["tokens_per_sec"]
+    metric = "attention_ab_tokens_per_sec"
+    anchor = _anchor_for(metric)
+    return {
+        "metric": metric,
+        "value": value,
+        "unit": "tokens/sec",
+        "vs_baseline": round(value / anchor, 4) if anchor else 1.0,
+        "platform": platform,
+        "device_kind": device_kind,
+        "n_chips": 1,
+        "attention_ab": ab,
+    }
+
+
 _CHILD_FNS = {
     "resnet50": _bench_resnet50,
     "cnn": _bench_cnn,
     "mlp": _bench_mlp,
     "attention": _bench_attention,
+    "attention_ab": _bench_attention_ab,
     "transformer": _bench_transformer,
     "deq": _bench_deq,
     "unet": _bench_unet,
@@ -1691,6 +1881,18 @@ def _leg_breakdown(rec: dict) -> dict:
         # accounting under the plan-derived sharding.
         out["dispatches_per_update"] = par.get("dispatches_per_update")
         out["window"] = par.get("fused_window")
+    attn_ab = rec.get("attention_ab")
+    if isinstance(attn_ab, dict):
+        # The kernel-plane A/B's headline ratios, lifted next to the
+        # fused-window ones so one breakdown block carries both
+        # dispatch- and kernel-level attribution.
+        out["attention_ab"] = {
+            "train_speedup": (attn_ab.get("train") or {}).get("speedup"),
+            "decode_speedup": (attn_ab.get("decode") or {}).get("speedup"),
+            "hbm_temp_saved_bytes": (attn_ab.get("train") or {}).get(
+                "hbm_temp_saved_bytes"
+            ),
+        }
     fused = rec.get("fused_window")
     if isinstance(fused, dict):
         # The fused-vs-pipelined dispatch accounting per leg: how many
@@ -2065,6 +2267,17 @@ def main() -> None:
                 k: lm[k] for k in ("value", "unit", "mfu", "vs_baseline")
                 if k in lm
             }
+    # Kernel-plane A/B (flash vs naive through the model switch, both
+    # hot paths) — runs on the CPU fallback too: the retrace and
+    # compiled-memory accounting is meaningful there even though the
+    # interpret-mode flash timings are not.
+    if remaining() > 300 and result["metric"] != "bench_failed":
+        ab = _run_child(
+            "attention_ab", min(360.0, remaining() - 60),
+            probe_platform if accel_ok else "cpu",
+        )
+        if ab is not None and "attention_ab" in ab:
+            result["attention_ab"] = ab["attention_ab"]
     if accel_ok and remaining() > 200 and result["metric"] != "bench_failed":
         deq = _run_child("deq", min(240.0, remaining() - 60), probe_platform)
         if deq is not None:

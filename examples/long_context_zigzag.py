@@ -72,20 +72,16 @@ def main() -> None:
 
     def loss_fn(p, mstate, batch_tokens):
         # batch_tokens arrive zigzag-permuted along the sequence.
-        try:
-            shard_map = jax.shard_map
-        except AttributeError:  # pragma: no cover - older jax
-            from jax.experimental.shard_map import shard_map
+        from fluxmpi_tpu.parallel._compat import shard_map_unchecked
 
         def apply_local(p, toks):
             return model.apply(p, toks, train=False)
 
-        logits = shard_map(
+        logits = shard_map_unchecked(
             apply_local,
             mesh=mesh,
             in_specs=(P(), P("dp", "sp")),
             out_specs=P("dp", "sp"),
-            check_vma=False,
         )(p, batch_tokens)
         # Next-token prediction in the ORIGINAL order: un-permute both
         # logits and tokens, shift by one.

@@ -33,11 +33,7 @@ from jax.sharding import PartitionSpec as P
 import fluxmpi_tpu as fm
 from fluxmpi_tpu.models import TransformerEncoder
 from fluxmpi_tpu.parallel.ring import ring_attention_fn
-
-try:
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from fluxmpi_tpu.parallel._compat import shard_map_unchecked
 
 n_sp = 4 if (args.simulate or jax.device_count()) >= 4 else 1
 mesh = fm.init(mesh_shape={"dp": -1, "sp": n_sp})
@@ -71,22 +67,12 @@ def step(v, s, bx, by):
     return optax.apply_updates(v, updates), s, l
 
 
-try:
-    sharded = shard_map(
-        step,
-        mesh=mesh,
-        in_specs=(P(), P(), P("dp", "sp"), P("dp", "sp")),
-        out_specs=(P(), P(), P()),
-        check_vma=False,
-    )
-except TypeError:  # pragma: no cover
-    sharded = shard_map(
-        step,
-        mesh=mesh,
-        in_specs=(P(), P(), P("dp", "sp"), P("dp", "sp")),
-        out_specs=(P(), P(), P()),
-        check_rep=False,
-    )
+sharded = shard_map_unchecked(
+    step,
+    mesh=mesh,
+    in_specs=(P(), P(), P("dp", "sp"), P("dp", "sp")),
+    out_specs=(P(), P(), P()),
+)
 sharded = jax.jit(sharded)
 
 losses = []

@@ -87,9 +87,13 @@ manager = CheckpointManager(ckpt_dir, max_to_keep=2)
 
 first = last = None
 for epoch in range(args.epochs):
+    # Compare epoch-mean losses: a single shuffled batch's loss is too
+    # noisy to witness learning over a 2-epoch smoke run.
+    total = nsteps = 0
     for batch in loader:
         state, loss = step(state, batch)
-    last = float(loss)
+        total, nsteps = total + float(loss), nsteps + 1
+    last = total / nsteps
     first = first if first is not None else last
     fm.fluxmpi_println(f"epoch {epoch}: loss {last:.4f}")
     manager.save(epoch, state)

@@ -1,4 +1,4 @@
-"""The fluxlint rule set — six invariants this repo has paid for.
+"""The fluxlint rule set — seven invariants this repo has paid for.
 
 Each rule's docstring names the contract it enforces and the bug class
 (from CHANGES.md history) that motivates it; docs/static_analysis.md
@@ -1013,6 +1013,106 @@ class UndocumentedEnvVar(Rule):
                 )
 
 
+# ---------------------------------------------------------------------------
+# Rule 7: jax-compat-drift
+# ---------------------------------------------------------------------------
+
+
+class JaxCompatDrift(Rule):
+    """The version-compat seam contract (parallel/_compat.py): jax APIs
+    whose spelling drifted across the jax versions this repo spans are
+    wrapped ONCE, in ``fluxmpi_tpu/parallel/_compat.py`` — everything
+    else imports the wrapper. A second try/except copy of the same
+    probe is exactly how the kernel plane went dark for three API
+    renames (ISSUE 19): each module's private fallback rotted at a
+    different rate.
+
+    Flagged anywhere outside the seam:
+
+    1. ``lax.axis_size`` / ``jax.lax.axis_size`` attribute use (absent
+       on older jax) — use ``_compat.axis_size(name)``;
+    2. old pallas compiler-params spellings — any ``*CompilerParams``
+       construction (``pltpu.CompilerParams`` / ``TPUCompilerParams``)
+       — use ``_compat.pallas_tpu_compiler_params(...)``;
+    3. a raw ``shard_map(...)`` call carrying the drifted validation
+       keyword (``check_vma=`` new spelling / ``check_rep=`` old) — use
+       ``_compat.shard_map_unchecked(...)`` (or plain
+       ``_compat.shard_map`` without the keyword).
+    """
+
+    id = "jax-compat-drift"
+    severity = "error"
+    description = "drifted jax API spelled directly instead of via parallel/_compat"
+
+    _ALLOWED = ("fluxmpi_tpu/parallel/_compat.py",)
+
+    def check(self, module: ModuleSource, ctx: Any) -> Iterator[Finding]:
+        if module.path in self._ALLOWED:
+            return
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Attribute) and node.attr == "axis_size":
+                root = value_root(node)
+                if root in ("jax", "lax"):
+                    yield self.finding(
+                        module.path,
+                        node,
+                        "jax.lax.axis_size drifted across jax versions "
+                        "(absent on older releases) — import axis_size "
+                        "from fluxmpi_tpu.parallel._compat, the one "
+                        "version probe",
+                        "axis_size",
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                for alias in node.names:
+                    if alias.name == "axis_size" and mod.endswith("lax"):
+                        yield self.finding(
+                            module.path,
+                            node,
+                            "importing axis_size from jax.lax drifts "
+                            "across jax versions — import it from "
+                            "fluxmpi_tpu.parallel._compat instead",
+                            "axis_size",
+                        )
+                    elif alias.name.endswith("CompilerParams"):
+                        yield self.finding(
+                            module.path,
+                            node,
+                            f"pallas {alias.name} was renamed across jax "
+                            f"versions — build compiler params via "
+                            f"fluxmpi_tpu.parallel._compat."
+                            f"pallas_tpu_compiler_params(...)",
+                            "compiler_params",
+                        )
+            elif isinstance(node, ast.Call):
+                name = terminal_name(node.func)
+                if name is None:
+                    continue
+                if name.endswith("CompilerParams"):
+                    yield self.finding(
+                        module.path,
+                        node,
+                        f"pallas {name} was renamed across jax versions "
+                        f"(CompilerParams ↔ TPUCompilerParams) — build "
+                        f"compiler params via fluxmpi_tpu.parallel."
+                        f"_compat.pallas_tpu_compiler_params(...)",
+                        "compiler_params",
+                    )
+                elif name == "shard_map":
+                    for kw in node.keywords:
+                        if kw.arg in ("check_vma", "check_rep"):
+                            yield self.finding(
+                                module.path,
+                                kw.value,
+                                f"shard_map {kw.arg}= drifted across jax "
+                                f"versions (check_rep ↔ check_vma) — call "
+                                f"fluxmpi_tpu.parallel._compat."
+                                f"shard_map_unchecked(...), which owns the "
+                                f"keyword probe",
+                                f"shard_map:{kw.arg}",
+                            )
+
+
 def default_rules() -> list[Rule]:
     return [
         SpmdDivergentCollective(),
@@ -1021,4 +1121,5 @@ def default_rules() -> list[Rule]:
         UnregisteredFaultSite(),
         HandBuiltMesh(),
         UndocumentedEnvVar(),
+        JaxCompatDrift(),
     ]

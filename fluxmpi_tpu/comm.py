@@ -47,11 +47,6 @@ from .telemetry.flight_recorder import (
     get_flight_recorder as _flight_recorder,
 )
 
-try:  # jax >= 0.4.35 exposes shard_map at top level
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map  # type: ignore
-
 __all__ = [
     "cpu",
     "device",
@@ -208,6 +203,10 @@ def _collective_fn(
             idx = jax.lax.axis_index(axis)
             return jnp.where(idx == root, red, x)
         raise AssertionError(kind)
+
+    # Lazy import: the compat seam lives under fluxmpi_tpu.parallel, whose
+    # package init must not run while fluxmpi_tpu's own init is mid-import.
+    from .parallel._compat import shard_map
 
     fn = shard_map(body, mesh=mesh, in_specs=(spec,), out_specs=spec)
     # Donation lets XLA write the reduction into the input buffer — the

@@ -421,6 +421,8 @@ class MoEEncoder(TransformerEncoder):
             dtype=self.dtype,
             attention_fn=self.attention_fn,
             decode=self.decode,
+            attention=self.attention,
+            attention_causal=self.attention_causal,
             ln_eps=self.ln_eps,
             num_experts=self.num_experts,
             capacity_factor=self.capacity_factor,
@@ -480,6 +482,8 @@ class MoETransformerLM(TransformerLM):
             dtype=self.dtype,
             attention_fn=self.attention_fn,
             decode=self.decode,
+            attention=self.attention,
+            attention_causal=True,
             ln_eps=self.ln_eps,
             num_experts=self.num_experts,
             capacity_factor=self.capacity_factor,

@@ -25,6 +25,20 @@ import jax.numpy as jnp
 __all__ = ["TransformerEncoder", "TransformerLM"]
 
 
+def _resolve_attention_mode(mode: str) -> str:
+    """Resolve the ``attention=`` switch: ``"auto"`` engages the Pallas
+    flash kernel on TPU backends and keeps the dense attend elsewhere
+    (the kernel only *runs* in pallas interpret mode off-TPU — correct,
+    but an emulation path, not a fast one)."""
+    if mode == "auto":
+        return "flash" if jax.default_backend() == "tpu" else "naive"
+    if mode not in ("naive", "flash"):
+        raise ValueError(
+            f"attention must be 'naive', 'flash', or 'auto'; got {mode!r}"
+        )
+    return mode
+
+
 class EncoderBlock(nn.Module):
     d_model: int
     num_heads: int
@@ -33,6 +47,8 @@ class EncoderBlock(nn.Module):
     dtype: jnp.dtype
     attention_fn: Callable | None = None
     decode: bool = False
+    attention: str = "naive"
+    attention_causal: bool = False
     ln_eps: float = 1e-6
 
     def make_ff(self) -> nn.Module | None:
@@ -45,10 +61,38 @@ class EncoderBlock(nn.Module):
     @nn.compact
     def __call__(self, x, *, train: bool = True, mask=None):
         attn_kwargs = {}
-        # Autoregressive decoding uses flax's KV cache with the plain
-        # dense single-query attend — a custom attention_fn (flash/ring)
-        # is a training-time kernel and is bypassed at decode.
-        if self.attention_fn is not None and not self.decode:
+        mode = _resolve_attention_mode(self.attention)
+        if mode == "flash":
+            if self.attention_fn is not None:
+                raise ValueError(
+                    "attention='flash' conflicts with an explicit "
+                    "attention_fn — pass one or the other"
+                )
+            from ..ops.flash_attention import flash_attention_fn
+
+            # The flash kernel rides BOTH hot paths. Training: the mask
+            # (causal and/or padding/packing) is recovered into segment
+            # ids; ``attention_causal`` folds the causal structure into
+            # the kernel so upper-triangle tiles skip compute. Decode:
+            # flax's cache-index mask is a trailing valid prefix —
+            # exactly representable by segment ids, which double as the
+            # padding/alias mask over block-table-gathered caches (the
+            # serving engine's paged pool; positions past the cache
+            # index, trash-block rows included, land in segment 0 and
+            # their fully-masked k-tiles are skipped). The decode mask
+            # is representable by construction, so the O(s·k) runtime
+            # fidelity check is skipped there; training masks arrive
+            # from callers and stay checked.
+            attn_kwargs["attention_fn"] = flash_attention_fn(
+                causal=self.attention_causal and not self.decode,
+                mask_check=not self.decode,
+            )
+        elif self.attention_fn is not None and not self.decode:
+            # Autoregressive decoding uses flax's KV cache with the plain
+            # dense single-query attend — a custom attention_fn
+            # (ring/ulysses) is a training-time kernel and is bypassed at
+            # decode. The attention='flash' switch above is the decode-
+            # capable path.
             attn_kwargs["attention_fn"] = self.attention_fn
         h = nn.LayerNorm(epsilon=self.ln_eps, dtype=self.dtype, name="ln1")(x)
         h = nn.MultiHeadDotProductAttention(
@@ -84,6 +128,8 @@ class TransformerEncoder(nn.Module):
     dtype: jnp.dtype = jnp.float32
     attention_fn: Callable | None = None
     decode: bool = False
+    attention: str = "naive"
+    attention_causal: bool = False
     ln_eps: float = 1e-6
 
     def make_block(self, i: int) -> nn.Module:
@@ -96,6 +142,8 @@ class TransformerEncoder(nn.Module):
             dtype=self.dtype,
             attention_fn=self.attention_fn,
             decode=self.decode,
+            attention=self.attention,
+            attention_causal=self.attention_causal,
             ln_eps=self.ln_eps,
             name=f"block_{i}",
         )
@@ -132,6 +180,14 @@ class TransformerLM(nn.Module):
     dtype: jnp.dtype = jnp.float32
     attention_fn: Callable | None = None
     decode: bool = False
+    # attention="flash"|"naive"|"auto": the kernel-plane switch. "flash"
+    # routes every attend — the training forward (and its custom_vjp
+    # backward) AND cached single-position decode — through the Pallas
+    # flash kernels of fluxmpi_tpu.ops.flash_attention; "auto" picks
+    # flash on TPU and naive elsewhere. Orthogonal to attention_fn
+    # (ring/ulysses sequence parallelism), which stays a training-time
+    # kernel; combining both raises.
+    attention: str = "naive"
     ln_eps: float = 1e-6
 
     def make_encoder(self) -> nn.Module:
@@ -145,6 +201,12 @@ class TransformerLM(nn.Module):
             dtype=self.dtype,
             attention_fn=self.attention_fn,
             decode=self.decode,
+            attention=self.attention,
+            # The LM always applies its own causal mask at train time, so
+            # the flash kernel can fold causality in and skip the upper
+            # triangle (decode composes causality from the cache index
+            # instead — EncoderBlock drops the flag there).
+            attention_causal=True,
             ln_eps=self.ln_eps,
             name="encoder",
         )

@@ -1,4 +1,29 @@
-"""jax version-compat shims shared by the parallel modules."""
+"""jax version-compat shims shared by the parallel modules.
+
+This module is the **single seam** between the repo and drifting jax
+API spellings. Everything that changed name or signature across the jax
+versions this repo supports gets one wrapper here, and every other
+module imports the wrapper — the next jax bump is a one-file fix. The
+``jax-compat-drift`` fluxlint rule enforces the discipline: direct use
+of the drifted spellings (``jax.lax.axis_size``, pallas
+``*CompilerParams`` classes, ``shard_map(..., check_vma=)``) outside
+this file is a finding.
+
+Current shims:
+
+- :data:`shard_map` — top-level ``jax.shard_map`` on newer jax, the
+  ``jax.experimental.shard_map`` export on older.
+- :func:`shard_map_unchecked` — shard_map with the replication checker
+  off (``check_vma`` on newer jax, ``check_rep`` on older).
+- :func:`axis_size` — ``jax.lax.axis_size`` on newer jax; on older jax
+  ``lax.psum(1, name)``, which returns the same concrete axis size
+  inside a binding context and raises the same ``NameError`` on an
+  unbound axis (callers' ``except NameError`` fallbacks keep working).
+- :func:`pallas_tpu_compiler_params` — builds the pallas TPU
+  compiler-params struct under whichever spelling this jax exports
+  (``pltpu.CompilerParams`` on newer jax, ``pltpu.TPUCompilerParams``
+  on older).
+"""
 
 from __future__ import annotations
 
@@ -9,7 +34,12 @@ try:
 except AttributeError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map  # type: ignore
 
-__all__ = ["shard_map", "shard_map_unchecked"]
+__all__ = [
+    "axis_size",
+    "pallas_tpu_compiler_params",
+    "shard_map",
+    "shard_map_unchecked",
+]
 
 
 def shard_map_unchecked(body, mesh, in_specs, out_specs):
@@ -27,3 +57,35 @@ def shard_map_unchecked(body, mesh, in_specs, out_specs):
             body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
             check_rep=False,
         )
+
+
+def axis_size(name):
+    """Size of the bound mesh axis ``name``, under either jax spelling.
+
+    Newer jax exposes ``jax.lax.axis_size``; older jax gets the same
+    value from ``psum(1, name)`` (a concrete python int when the axis is
+    bound — the collective folds away at trace time). Both raise
+    ``NameError("unbound axis name: ...")`` outside a binding context,
+    so callers that probe for an unbound axis (ring/ulysses init paths)
+    behave identically on either version.
+    """
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(name)
+    return jax.lax.psum(1, name)
+
+
+def pallas_tpu_compiler_params(**kwargs):
+    """The pallas TPU compiler-params struct, under either spelling.
+
+    Newer jax renamed ``pltpu.TPUCompilerParams`` to
+    ``pltpu.CompilerParams``; the fields kernels here use
+    (``dimension_semantics``) are unchanged. Imported lazily so this
+    module stays cheap for non-pallas users of the seam.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:  # pragma: no cover - older jax spelling
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)

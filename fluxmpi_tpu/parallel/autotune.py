@@ -346,10 +346,19 @@ def _static_cost(
     candidate's shardings and read XLA's cost analysis — per-device
     FLOPs and bytes accessed, communication the partitioner inserted
     included. ``lower().compile()`` consumes only avals: no data is
-    placed on the candidate's mesh and nothing executes."""
+    placed on the candidate's mesh and nothing executes.
+
+    Pallas kernels (the flash-attention hot path) lower to opaque custom
+    calls whose matmuls XLA's cost model reports as zero, so the traced
+    jaxpr is walked for ``pallas_call`` equations and their analytic
+    cost (:func:`~fluxmpi_tpu.utils.flops.pallas_kernel_cost`) is folded
+    in, divided evenly across the mesh — attention work shards with the
+    batch/heads under every dp×fsdp×tp candidate, so the per-device
+    share is layout-invariant but the TOTAL is real: a kernel-heavy
+    model no longer looks computation-free next to its communication."""
     import optax
 
-    from ..utils.flops import executable_cost
+    from ..utils.flops import executable_cost, pallas_kernel_cost
 
     mesh = plan.mesh
     state_avals = _sharded_avals(
@@ -383,7 +392,19 @@ def _static_cost(
         compiled = jax.jit(update).lower(state_avals, batch_avals).compile()
     except Exception:
         return None
-    return executable_cost(compiled)
+    cost = executable_cost(compiled)
+    if cost is not None:
+        try:
+            kernel = pallas_kernel_cost(
+                jax.make_jaxpr(update)(state_avals, batch_avals)
+            )
+        except Exception:  # pragma: no cover - cost stays XLA-only
+            kernel = None
+        if kernel:
+            ndev = float(mesh.devices.size) or 1.0
+            cost["flops"] += kernel["flops"] / ndev
+            cost["bytes_accessed"] += kernel["bytes_accessed"] / ndev
+    return cost
 
 
 def _score(cost: dict[str, float] | None) -> float | None:

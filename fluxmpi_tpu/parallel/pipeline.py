@@ -67,7 +67,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ._compat import shard_map_unchecked
+from ._compat import axis_size, shard_map_unchecked
 from .plan import plan_axis_name
 
 __all__ = [
@@ -210,7 +210,7 @@ def pipeline_apply(
     the 1F1B-equivalent activation-memory bound (see module docstring).
     """
     axis_name = axis_name or plan_axis_name("pp")
-    n_stages = jax.lax.axis_size(axis_name)
+    n_stages = axis_size(axis_name)
     stage_idx = jax.lax.axis_index(axis_name)
     v = int(interleave)
     if v < 1:

@@ -42,7 +42,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ._compat import shard_map_unchecked
+from ._compat import axis_size, shard_map_unchecked
 from .plan import plan_axis_name
 
 __all__ = [
@@ -423,7 +423,7 @@ def ring_attention(
         )
     name = axis_name or plan_axis_name("sp")
     try:
-        n = jax.lax.axis_size(name)
+        n = axis_size(name)
     except NameError:
         # Unbound axis: not inside a shard_map binding `name` — e.g.
         # ``module.init`` on a ring-attention model outside the mapped
@@ -596,7 +596,7 @@ def zigzag_ring_attention(
         )
     name = axis_name or plan_axis_name("sp")
     try:
-        n = jax.lax.axis_size(name)
+        n = axis_size(name)
     except NameError:
         # Unbound axis (module.init outside shard_map): n=1 zigzag layout
         # is the identity permutation, so plain causal attention is exact.

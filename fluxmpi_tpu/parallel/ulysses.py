@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ._compat import shard_map_unchecked
+from ._compat import axis_size, shard_map_unchecked
 from .plan import plan_axis_name
 from .ring import _adapter_dropout, _fold_seed, _local_attend
 
@@ -92,7 +92,7 @@ def ulysses_attention(
             "uint32 scalar)"
         )
     try:
-        n = jax.lax.axis_size(name)
+        n = axis_size(name)
     except NameError:
         return _local_attend(
             q, k, v, causal=causal, segment_ids=segment_ids,

@@ -80,6 +80,7 @@ _ENV_SLOTS = "FLUXMPI_TPU_SERVING_SLOTS"
 _ENV_BLOCK_SIZE = "FLUXMPI_TPU_SERVING_BLOCK_SIZE"
 _ENV_BLOCKS = "FLUXMPI_TPU_SERVING_BLOCKS"
 _ENV_QUEUE = "FLUXMPI_TPU_SERVING_QUEUE"
+_ENV_ATTENTION = "FLUXMPI_TPU_SERVING_ATTENTION"
 
 _DEFAULT_SLOTS = 8
 _DEFAULT_BLOCK_SIZE = 16
@@ -106,11 +107,13 @@ class ServingConfig:
         block_size: int | None = None,
         num_blocks: int | None = None,
         max_queue: int | None = None,
+        attention: str | None = None,
     ):
         self.slots = slots
         self.block_size = block_size
         self.num_blocks = num_blocks
         self.max_queue = max_queue
+        self.attention = attention
 
 
 _config: ServingConfig | None = None
@@ -173,11 +176,13 @@ def configure(spec: Any = None) -> ServingConfig | None:
         _config = ServingConfig()
         return _config
     if isinstance(spec, dict):
-        unknown = set(spec) - {"slots", "block_size", "num_blocks", "max_queue"}
+        unknown = set(spec) - {
+            "slots", "block_size", "num_blocks", "max_queue", "attention",
+        }
         if unknown:
             raise ValueError(
                 f"unknown serving config keys {sorted(unknown)}; expected "
-                f"slots/block_size/num_blocks/max_queue"
+                f"slots/block_size/num_blocks/max_queue/attention"
             )
         _config = ServingConfig(**spec)
         return _config
@@ -429,6 +434,15 @@ class InferenceEngine:
         plane's device ``bytes_limit`` before allocating (raises
         ``RuntimeError`` when it cannot fit — OOM-safe admission starts
         at construction).
+      attention: ``"flash"``/``"naive"``/``"auto"`` overrides the
+        model's kernel-plane switch for prefill and the paged decode
+        step (default: ``init(serving=)`` /
+        ``FLUXMPI_TPU_SERVING_ATTENTION`` / inherit the model's). With
+        ``"flash"`` the decode twin reads the block-table-gathered K/V
+        through the flash kernel's segment ids — positions past the
+        cache index (trash-block rows included) mask out and skip
+        compute — while the step stays one fixed-shape program (the
+        no-retrace join contract is unchanged).
 
     The engine registers itself as the module's active engine
     (:func:`get_engine`) so the live export plane's ``/status`` board
@@ -452,12 +466,41 @@ class InferenceEngine:
         clock: Callable[[], float] = time.perf_counter,
         flush_every: int = 16,
         check_memory: bool = True,
+        attention: str | None = None,
     ):
         import jax.numpy as jnp
 
         from ..models.generate import _decode_twin, cache_template
 
         cfg = _config or ServingConfig()
+        # attention="flash"|"naive"|"auto" overrides the model's own
+        # kernel-plane switch for BOTH serving hot paths (bucketed
+        # prefill and the vmapped paged decode): the decode twin's flash
+        # kernel reads the block-table-gathered K/V through segment ids
+        # recovered from flax's cache-index mask, so trash-block/alias
+        # positions are masked (and their fully-masked tiles skipped)
+        # with no extra plumbing, and the step stays one fixed-shape
+        # program — mid-flight joins still retrace nothing. None (the
+        # default) inherits whatever the model was built with.
+        mode = attention if attention is not None else (
+            cfg.attention if cfg.attention is not None
+            else os.environ.get(_ENV_ATTENTION) or None
+        )
+        if mode is not None:
+            if mode not in ("naive", "flash", "auto"):
+                raise ValueError(
+                    f"attention must be 'naive', 'flash', or 'auto'; "
+                    f"got {mode!r}"
+                )
+            try:
+                model = model.clone(attention=mode)
+            except TypeError:
+                raise ValueError(
+                    f"attention={mode!r} requires a model with the "
+                    f"attention switch (TransformerLM-style); "
+                    f"{type(model).__name__} has no such field"
+                ) from None
+        self.attention = mode
         self.model = model
         self.params = params
         self.slots = _resolve(slots, cfg.slots, _ENV_SLOTS, _DEFAULT_SLOTS)

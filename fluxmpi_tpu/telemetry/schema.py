@@ -407,6 +407,12 @@ _BENCH_OPTIONAL: dict[str, tuple[type, ...]] = {
     # bank identity. Validated as an embedded autotune record by
     # validate_bench_record when it carries the schema tag.
     "autotune": (dict,),
+    # Kernel-plane A/B (ISSUE 19): attention="flash" vs "naive" through
+    # the model switch on BOTH hot paths — training fwd+bwd (per-leg
+    # throughput + compiled HBM footprint from memory_analysis) and
+    # paged serving decode (per-leg tokens/sec + steady-state retrace
+    # count, which must be 0 per the no-retrace join contract).
+    "attention_ab": (dict,),
 }
 
 

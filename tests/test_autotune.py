@@ -245,6 +245,72 @@ def test_prune_memory_kills_infeasible_even_pure_dp(world):
 
 
 # ---------------------------------------------------------------------------
+# Kernel-plane cost term (ISSUE 19): pallas calls are opaque to XLA's
+# cost model; the analytic jaxpr walk restores their FLOPs/bytes.
+# ---------------------------------------------------------------------------
+
+
+def test_pallas_kernel_cost_counts_flash_dots(world):
+    from fluxmpi_tpu.ops import flash_attention
+    from fluxmpi_tpu.utils.flops import pallas_kernel_cost
+
+    b, s, h, d = 2, 64, 2, 16
+    q = jnp.zeros((b, s, h, d), jnp.float32)
+
+    cost = pallas_kernel_cost(
+        jax.make_jaxpr(lambda q: flash_attention(q, q, q).sum())(q)
+    )
+    assert cost is not None and cost["calls"] == 1
+    # The kernel body's QK^T and PV dots, per grid point x grid size:
+    # 2 dots x 2·b·h·s·s·d = 4·b·h·s²·d total.
+    assert cost["flops"] == pytest.approx(4.0 * b * h * s * s * d)
+    assert cost["bytes_accessed"] > 0
+
+    # grad adds the backward kernels (dq and dkv passes).
+    gcost = pallas_kernel_cost(
+        jax.make_jaxpr(jax.grad(lambda q: flash_attention(q, q, q).sum()))(q)
+    )
+    assert gcost is not None and gcost["calls"] >= 2
+    assert gcost["flops"] > cost["flops"]
+
+    # No pallas calls -> None, so callers can tell "no kernels" from 0.
+    assert pallas_kernel_cost(
+        jax.make_jaxpr(lambda a: a @ a)(jnp.zeros((4, 4)))
+    ) is None
+
+
+def test_static_cost_folds_pallas_kernel_work(world):
+    """Two candidate scorings differing ONLY by a flash-attention call:
+    XLA prices the pallas custom call at zero FLOPs, so without the
+    analytic fold the kernel-heavy loss would look computation-free;
+    with it, its static cost strictly exceeds the dense-free twin's."""
+    from fluxmpi_tpu import ParallelConfig
+    from fluxmpi_tpu.ops import flash_attention
+
+    plan = ParallelConfig(dp=8).resolve(jax.devices())
+    rng = np.random.default_rng(0)
+    params = {"w": jnp.asarray(rng.normal(size=(16, 16), scale=0.1),
+                               jnp.float32)}
+    batch = {"x": np.asarray(rng.normal(size=(8, 32, 2, 16)), np.float32)}
+    opt = optax.adamw(1e-3)
+    template = at.state_template(params, opt)
+
+    def loss_base(p, mstate, b_):
+        q = b_["x"] @ p["w"]
+        return (q ** 2).mean(), mstate
+
+    def loss_flash(p, mstate, b_):
+        q = b_["x"] @ p["w"]
+        return (flash_attention(q, q, q) ** 2).mean(), mstate
+
+    base = at._static_cost(loss_base, opt, template, batch, plan)
+    flash = at._static_cost(loss_flash, opt, template, batch, plan)
+    assert base is not None and flash is not None
+    assert flash["flops"] > base["flops"]
+    assert flash["bytes_accessed"] > base["bytes_accessed"]
+
+
+# ---------------------------------------------------------------------------
 # The full search, end to end on the real train_loop (slow-ish: real
 # fused-window trials) — plus the bank contract in the same process.
 # ---------------------------------------------------------------------------

@@ -220,6 +220,22 @@ def test_leg_breakdown_lifts_fused_window():
     }
 
 
+def test_leg_breakdown_lifts_attention_ab():
+    rec = {
+        "value": 100.0,
+        "attention_ab": {
+            "train": {"speedup": 1.4, "hbm_temp_saved_bytes": 1995872.0},
+            "decode": {"speedup": 1.1},
+        },
+    }
+    out = bench._leg_breakdown(rec)
+    assert out["attention_ab"] == {
+        "train_speedup": 1.4,
+        "decode_speedup": 1.1,
+        "hbm_temp_saved_bytes": 1995872.0,
+    }
+
+
 def test_run_scaling_includes_breakdown(monkeypatch):
     def fake_run_child(config, timeout, platform, extra_env=None):
         n = extra_env.get("FLUXMPI_TPU_BENCH_DEVICES", "1")
@@ -338,6 +354,60 @@ def test_bench_serving_ab_smoke(tmp_path):
     assert ab["continuous"]["decode_steps"] < ab["static"]["decode_steps"]
     assert ab["steady_retraces"] == 0
     json_path = tmp_path / "serving.json"
+    json_path.write_text(json.dumps(result))
+    check = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(here, "scripts", "check_metrics_schema.py"),
+            str(json_path),
+        ],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert check.returncode == 0, check.stdout + check.stderr
+
+
+def test_bench_attention_ab_smoke(tmp_path):
+    """The kernel-plane A/B's tier-1 smoke (FLUXMPI_TPU_BENCH_SMOKE=1 +
+    _CONFIG=attention_ab): flash vs naive through the model switch on
+    both hot paths. The acceptance claims asserted from the record:
+    zero steady-state retraces on every leg (training AND paged decode
+    with mid-flight joins), the same decoded token count in both modes
+    (the kernel swap changes no scheduling), and a strictly smaller
+    compiled temp footprint for flash — the dense attend materializes
+    [s, s] scores, flash streams tiles. Throughput speedups are NOT
+    asserted here: on CPU the flash legs run in pallas interpret mode
+    (emulation, not a fast path)."""
+    import os
+    import subprocess
+    import sys
+
+    here = os.path.dirname(os.path.abspath(bench.__file__))
+    env = {
+        **os.environ,
+        "FLUXMPI_TPU_BENCH_SMOKE": "1",
+        "FLUXMPI_TPU_BENCH_CONFIG": "attention_ab",
+    }
+    proc = subprocess.run(
+        [sys.executable, os.path.join(here, "bench.py")],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=here,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    result = bench._parse_json_line(proc.stdout)
+    assert result is not None and result["metric"] == "attention_ab_tokens_per_sec", (
+        proc.stderr[-2000:]
+    )
+    assert result.get("smoke") == 1
+    ab = result["attention_ab"]
+    for path in ("train", "decode"):
+        for mode in ("naive", "flash"):
+            assert ab[path][mode]["steady_retraces"] == 0, (path, mode, ab)
+    assert ab["decode"]["naive"]["tokens"] == ab["decode"]["flash"]["tokens"] > 0
+    naive_hbm = ab["train"]["naive"]["compiled_hbm"]
+    flash_hbm = ab["train"]["flash"]["compiled_hbm"]
+    assert flash_hbm["temp_bytes"] < naive_hbm["temp_bytes"], ab
+    assert ab["train"]["hbm_temp_saved_bytes"] > 0
+    json_path = tmp_path / "attention_ab.json"
     json_path.write_text(json.dumps(result))
     check = subprocess.run(
         [

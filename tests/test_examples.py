@@ -38,6 +38,11 @@ _CASES = [
     ("long_context_zigzag.py", [], "LONG_CONTEXT_ZIGZAG_OK"),
 ]
 
+# Examples whose convergence run dominates the tier-1 wall clock (the
+# 14-epoch lm_pretrain alone is ~7 minutes on the CPU mesh) run in the
+# slow tier; `pytest -m slow` still exercises them end to end.
+_SLOW = {"lm_pretrain.py"}
+
 
 def test_every_example_is_covered():
     """A new example must get a smoke test (or be excluded here on
@@ -52,8 +57,14 @@ def test_every_example_is_covered():
 
 @pytest.mark.parametrize(
     "name,argv,sentinel,timeout",
-    [c if len(c) == 4 else (*c, 420) for c in _CASES],
-    ids=[c[0] for c in _CASES],
+    [
+        pytest.param(
+            *(c if len(c) == 4 else (*c, 420)),
+            id=c[0],
+            marks=[pytest.mark.slow] if c[0] in _SLOW else [],
+        )
+        for c in _CASES
+    ],
 )
 def test_example_runs(name, argv, sentinel, timeout):
     env = dict(os.environ)

@@ -22,6 +22,7 @@ from fluxmpi_tpu.analysis import (
 )
 from fluxmpi_tpu.analysis.rules import (
     HandBuiltMesh,
+    JaxCompatDrift,
     SpmdDivergentCollective,
     UndocumentedEnvVar,
     UnguardedHotPathInstrumentation,
@@ -711,6 +712,124 @@ def test_cli_loads_without_importing_jax():
     )
     assert proc.returncode == 0, proc.stderr
     assert proc.stdout.strip() == "0"
+
+
+# ---------------------------------------------------------------------------
+# Rule 7: jax-compat-drift
+# ---------------------------------------------------------------------------
+
+
+def test_compat_drift_flags_axis_size_spellings():
+    src = textwrap.dedent(
+        """
+        import jax
+        from jax import lax
+
+        def f():
+            n = jax.lax.axis_size("dp")
+            m = lax.axis_size("tp")
+            return n, m
+        """
+    )
+    r = lint_source(src, "fluxmpi_tpu/parallel/ring.py", _ctx(),
+                    rules=[JaxCompatDrift()])
+    assert _keys(r, "jax-compat-drift") == ["axis_size", "axis_size"]
+
+    imported = "from jax.lax import axis_size\n"
+    r = lint_source(imported, "fluxmpi_tpu/ops/x.py", _ctx(),
+                    rules=[JaxCompatDrift()])
+    assert _keys(r, "jax-compat-drift") == ["axis_size"]
+
+
+def test_compat_drift_flags_compiler_params_spellings():
+    src = textwrap.dedent(
+        """
+        from jax.experimental.pallas import tpu as pltpu
+
+        old = pltpu.TPUCompilerParams(dimension_semantics=("parallel",))
+        new = pltpu.CompilerParams(dimension_semantics=("parallel",))
+        """
+    )
+    r = lint_source(src, "fluxmpi_tpu/ops/k.py", _ctx(),
+                    rules=[JaxCompatDrift()])
+    assert _keys(r, "jax-compat-drift") == [
+        "compiler_params", "compiler_params",
+    ]
+
+    imported = "from jax.experimental.pallas.tpu import TPUCompilerParams\n"
+    r = lint_source(imported, "scripts/k.py", _ctx(), rules=[JaxCompatDrift()])
+    assert _keys(r, "jax-compat-drift") == ["compiler_params"]
+
+
+def test_compat_drift_flags_shard_map_validation_kwargs():
+    src = textwrap.dedent(
+        """
+        from jax.experimental.shard_map import shard_map
+
+        def f(body, mesh, spec):
+            a = shard_map(body, mesh=mesh, in_specs=(spec,), out_specs=spec,
+                          check_vma=False)
+            b = shard_map(body, mesh=mesh, in_specs=(spec,), out_specs=spec,
+                          check_rep=False)
+            return a, b
+        """
+    )
+    r = lint_source(src, "fluxmpi_tpu/parallel/p.py", _ctx(),
+                    rules=[JaxCompatDrift()])
+    assert _keys(r, "jax-compat-drift") == [
+        "shard_map:check_vma", "shard_map:check_rep",
+    ]
+
+
+def test_compat_drift_quiet_on_seam_and_wrappers():
+    # The seam itself owns the probes — exempt.
+    drifted = 'import jax\nn = jax.lax.axis_size("dp")\n'
+    r = lint_source(drifted, "fluxmpi_tpu/parallel/_compat.py", _ctx(),
+                    rules=[JaxCompatDrift()])
+    assert r.findings == []
+
+    # Consuming the wrappers is the blessed spelling.
+    good = textwrap.dedent(
+        """
+        from fluxmpi_tpu.parallel._compat import (
+            axis_size,
+            pallas_tpu_compiler_params,
+            shard_map_unchecked,
+        )
+
+        def f(body, mesh, spec, name):
+            n = axis_size(name)
+            params = pallas_tpu_compiler_params(
+                dimension_semantics=("parallel",)
+            )
+            mapped = shard_map_unchecked(
+                body, mesh, in_specs=(spec,), out_specs=spec
+            )
+            return n, params, mapped
+        """
+    )
+    r = lint_source(good, "fluxmpi_tpu/parallel/ring.py", _ctx(),
+                    rules=[JaxCompatDrift()])
+    assert r.findings == []
+
+    # A bare shard_map call WITHOUT the drifted kwarg is fine too (the
+    # compat module re-exports it for spec-checked call sites).
+    bare = textwrap.dedent(
+        """
+        from fluxmpi_tpu.parallel._compat import shard_map
+
+        def f(body, mesh, spec):
+            return shard_map(body, mesh=mesh, in_specs=(spec,),
+                             out_specs=spec)
+        """
+    )
+    r = lint_source(bare, "fluxmpi_tpu/comm.py", _ctx(),
+                    rules=[JaxCompatDrift()])
+    assert r.findings == []
+
+
+def test_compat_drift_in_default_rules():
+    assert any(r.id == "jax-compat-drift" for r in default_rules())
 
 
 # ---------------------------------------------------------------------------

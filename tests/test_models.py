@@ -701,6 +701,64 @@ def test_generate_works_with_flash_trained_model(world):
     assert np.all((np.asarray(out) >= 0) & (np.asarray(out) < 32))
 
 
+def test_attention_switch_flash_matches_naive_oracle(world):
+    """The kernel-plane switch (ISSUE 19): attention="flash" must be a
+    pure kernel substitution — same params, same batch, the fused-CE
+    training loss AND its gradients (through the flash custom_vjp)
+    match the naive dense attend to dtype tolerance, and greedy decode
+    streams bit-identical tokens."""
+    from fluxmpi_tpu.models import TransformerLM, generate
+
+    naive = TransformerLM(vocab_size=32, max_len=32, num_layers=2,
+                          d_model=32, num_heads=4, d_ff=64)
+    flash = naive.clone(attention="flash")
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.integers(0, 32, size=(2, 24)).astype(np.int32))
+    y = jnp.asarray(rng.integers(0, 32, size=(2, 24)).astype(np.int32))
+    variables = naive.init(jax.random.PRNGKey(0), x, train=False)
+
+    def loss(model):
+        def fn(p):
+            return model.apply(p, x, train=True, targets=y).mean()
+        return fn
+
+    l_n, g_n = jax.value_and_grad(loss(naive))(variables)
+    l_f, g_f = jax.value_and_grad(loss(flash))(variables)
+    np.testing.assert_allclose(float(l_f), float(l_n), atol=1e-5)
+    flat_n = jax.tree_util.tree_leaves(g_n)
+    flat_f = jax.tree_util.tree_leaves(g_f)
+    for a, b in zip(flat_f, flat_n):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5
+        )
+
+    prompt = x[:, :5]
+    np.testing.assert_array_equal(
+        np.asarray(generate(flash, variables, prompt, 6)),
+        np.asarray(generate(naive, variables, prompt, 6)),
+    )
+
+
+def test_attention_switch_validation(world):
+    """Switch error paths: an unknown mode raises at apply time,
+    attention='flash' conflicts with an explicit attention_fn, and
+    'auto' resolves to naive off-TPU (this suite runs on CPU)."""
+    from fluxmpi_tpu.models import TransformerLM
+    from fluxmpi_tpu.models.transformer import _resolve_attention_mode
+    from fluxmpi_tpu.ops import flash_attention_fn
+
+    assert _resolve_attention_mode("auto") == "naive"  # CPU backend
+    with pytest.raises(ValueError, match="attention must be"):
+        _resolve_attention_mode("fast")
+
+    x = jnp.zeros((1, 8), jnp.int32)
+    lm = TransformerLM(vocab_size=32, max_len=16, num_layers=1, d_model=32,
+                       num_heads=4, d_ff=64, attention="flash",
+                       attention_fn=flash_attention_fn(causal=True))
+    with pytest.raises(ValueError, match="conflicts"):
+        lm.init(jax.random.PRNGKey(0), x, train=False)
+
+
 def test_beam_search_beam1_matches_greedy(world):
     from fluxmpi_tpu.models import TransformerLM, beam_search, generate
 

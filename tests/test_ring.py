@@ -103,21 +103,15 @@ def test_ring_grad_finite(sp_mesh):
         out = ring_attention(q, k, v, axis_name="sp")
         return jnp.sum(out**2)
 
-    try:
-        sm = jax.shard_map
-    except AttributeError:
-        from jax.experimental.shard_map import shard_map as sm
-
     def per_device(q, k, v):
         l = loss(q, k, v)
         return jax.lax.psum(l, "sp")
 
-    mapped = sm(
+    mapped = shard_map_unchecked(
         per_device,
         mesh=sp_mesh,
         in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
         out_specs=P(),
-        check_vma=False,
     )
     g = jax.jit(jax.grad(lambda q, k, v: mapped(q, k, v)))(q, k, v)
     assert np.all(np.isfinite(np.asarray(g)))
@@ -146,11 +140,6 @@ def test_ring_flash_grad_matches_dense(sp_mesh):
 
     from fluxmpi_tpu.parallel.ring import ring_attention
 
-    try:
-        sm = jax.shard_map
-    except AttributeError:
-        from jax.experimental.shard_map import shard_map as sm
-
     q, k, v = _qkv(seq=32, seed=7)
 
     def per_device(q, k, v):
@@ -158,12 +147,11 @@ def test_ring_flash_grad_matches_dense(sp_mesh):
                              use_flash=True)
         return jax.lax.psum(jnp.sum(jnp.sin(out)), "sp")
 
-    mapped = sm(
+    mapped = shard_map_unchecked(
         per_device,
         mesh=sp_mesh,
         in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
         out_specs=P(),
-        check_vma=False,
     )
     gf = jax.jit(jax.grad(lambda q, k, v: mapped(q, k, v), argnums=(0, 1, 2)))(
         q, k, v
@@ -184,11 +172,6 @@ def test_transformer_with_ring_attention(sp_mesh):
 
     from fluxmpi_tpu.models import TransformerEncoder
     from fluxmpi_tpu.parallel.ring import ring_attention_fn
-
-    try:
-        sm = jax.shard_map
-    except AttributeError:
-        from jax.experimental.shard_map import shard_map as sm
 
     d_model, seq = 32, 32
     x = jnp.asarray(
@@ -211,12 +194,11 @@ def test_transformer_with_ring_attention(sp_mesh):
     def apply_local(v, xx):
         return ring_model.apply(v, xx, train=False)
 
-    mapped = sm(
+    mapped = shard_map_unchecked(
         apply_local,
         mesh=sp_mesh,
         in_specs=(P(), P(None, "sp")),
         out_specs=P(None, "sp"),
-        check_vma=False,
     )
     out = jax.jit(mapped)(variables, x)
     np.testing.assert_allclose(
@@ -229,14 +211,8 @@ def test_transformer_with_ring_attention(sp_mesh):
 
 from _oracles import dense_seg_attention as _dense_seg_attention  # noqa: E402
 
+from fluxmpi_tpu.parallel._compat import shard_map_unchecked  # noqa: E402
 
-def _sm():
-    try:
-        return jax.shard_map
-    except AttributeError:
-        from jax.experimental.shard_map import shard_map as sm
-
-        return sm
 
 
 @pytest.mark.parametrize("use_flash", [False, True])
@@ -263,12 +239,11 @@ def test_ring_segments_match_dense(sp_mesh, causal, use_flash):
             segment_ids=seg, use_flash=use_flash, block_q=8, block_k=8,
         )
 
-    mapped = _sm()(
+    mapped = shard_map_unchecked(
         per_device,
         mesh=sp_mesh,
         in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp"), P(None, "sp")),
         out_specs=P(None, "sp"),
-        check_vma=False,
     )
     out = jax.jit(mapped)(q, k, v, seg)
     expected = _dense_seg_attention(q, k, v, seg, seg, causal=causal)
@@ -329,12 +304,11 @@ def test_zigzag_grad_matches_dense(sp_mesh):
         out = zigzag_ring_attention(q, k, v, axis_name="sp")
         return jax.lax.psum(jnp.sum(jnp.sin(out)), "sp")
 
-    mapped = _sm()(
+    mapped = shard_map_unchecked(
         per_device,
         mesh=sp_mesh,
         in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
         out_specs=P(),
-        check_vma=False,
     )
 
     def loss_zigzag(q, k, v):
@@ -449,12 +423,11 @@ def test_ulysses_segments_match_dense(sp_mesh):
             q, k, v, axis_name="sp", segment_ids=seg
         )
 
-    mapped = _sm()(
+    mapped = shard_map_unchecked(
         per_device,
         mesh=sp_mesh,
         in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp"), P(None, "sp")),
         out_specs=P(None, "sp"),
-        check_vma=False,
     )
     out = jax.jit(mapped)(q, k, v, seg)
     expected = _dense_seg_attention(q, k, v, seg, seg)
@@ -475,12 +448,11 @@ def test_ulysses_grad_matches_dense(sp_mesh):
         out = ulysses_attention(q, k, v, axis_name="sp", causal=True)
         return jax.lax.psum(jnp.sum(jnp.sin(out)), "sp")
 
-    mapped = _sm()(
+    mapped = shard_map_unchecked(
         per_device,
         mesh=sp_mesh,
         in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
         out_specs=P(),
-        check_vma=False,
     )
     gf = jax.jit(jax.grad(lambda q, k, v: mapped(q, k, v), argnums=(0, 1, 2)))(
         q, k, v
@@ -572,12 +544,11 @@ def test_ring_gqa_grad_matches_dense(sp_mesh):
                              use_flash=True, block_q=4, block_k=4)
         return jax.lax.psum(jnp.sum(jnp.sin(out)), "sp")
 
-    mapped = _sm()(
+    mapped = shard_map_unchecked(
         per_device,
         mesh=sp_mesh,
         in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
         out_specs=P(),
-        check_vma=False,
     )
     gf = jax.jit(jax.grad(lambda q, k, v: mapped(q, k, v), argnums=(0, 1, 2)))(
         q, k, v
@@ -626,12 +597,11 @@ def test_ulysses_gqa_grad_matches_dense(world):
         out = ulysses_attention(q, k, v, axis_name="sp", causal=True)
         return jax.lax.psum(jnp.sum(jnp.sin(out)), "sp")
 
-    mapped = _sm()(
+    mapped = shard_map_unchecked(
         per_device,
         mesh=mesh,
         in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
         out_specs=P(),
-        check_vma=False,
     )
     gf = jax.jit(jax.grad(lambda q, k, v: mapped(q, k, v), argnums=(0, 1, 2)))(
         q, k, v
@@ -659,12 +629,11 @@ def test_ulysses_rejects_indivisible_kv_heads(sp_mesh):
     def per_device(q, k, v):
         return ulysses_attention(q, k, v, axis_name="sp")
 
-    mapped = _sm()(
+    mapped = shard_map_unchecked(
         per_device,
         mesh=sp_mesh,
         in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
         out_specs=P(None, "sp"),
-        check_vma=False,
     )
     with pytest.raises(ValueError, match="kv head count"):
         jax.jit(mapped)(q, k, v)
@@ -719,12 +688,11 @@ def test_zigzag_segments_grad_matches_dense(sp_mesh):
         )
         return jax.lax.psum(jnp.sum(jnp.sin(out)), "sp")
 
-    mapped = _sm()(
+    mapped = shard_map_unchecked(
         per_device,
         mesh=sp_mesh,
         in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp"), P(None, "sp")),
         out_specs=P(),
-        check_vma=False,
     )
 
     def loss_zigzag(q, k, v):
@@ -823,12 +791,11 @@ def test_ring_window_flash_grad_matches_dense(sp_mesh):
                              use_flash=True, window=10)
         return jax.lax.psum(jnp.sum(jnp.sin(out)), "sp")
 
-    mapped = _sm()(
+    mapped = shard_map_unchecked(
         per_device,
         mesh=sp_mesh,
         in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
         out_specs=P(),
-        check_vma=False,
     )
     gf = jax.jit(jax.grad(lambda q, k, v: mapped(q, k, v), argnums=(0, 1, 2)))(
         q, k, v
@@ -865,12 +832,11 @@ def test_ring_window_flash_segments_match_dense(sp_mesh):
             segment_ids=seg, use_flash=True, block_q=8, block_k=8,
         )
 
-    mapped = _sm()(
+    mapped = shard_map_unchecked(
         per_device,
         mesh=sp_mesh,
         in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp"), P(None, "sp")),
         out_specs=P(None, "sp"),
-        check_vma=False,
     )
     out = jax.jit(mapped)(q, k, v, seg)
     expected = _dense_seg_attention(q, k, v, seg, seg, causal=True, window=14)
@@ -904,12 +870,11 @@ def test_ring_window_flash_dropout_matches_oracle(sp_mesh):
             dropout_rate=rate, dropout_seed=seed,
         )
 
-    mapped = _sm()(
+    mapped = shard_map_unchecked(
         per_device,
         mesh=sp_mesh,
         in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
         out_specs=P(None, "sp"),
-        check_vma=False,
     )
     out = jax.jit(mapped)(q, k, v)
 
@@ -993,12 +958,11 @@ def test_ring_flash_dropout_matches_oracle(sp_mesh):
             block_q=8, block_k=8, dropout_rate=rate, dropout_seed=seed,
         )
 
-    mapped = _sm()(
+    mapped = shard_map_unchecked(
         per_device,
         mesh=sp_mesh,
         in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
         out_specs=P(None, "sp"),
-        check_vma=False,
     )
     out = jax.jit(mapped)(q, k, v)
 
@@ -1046,7 +1010,7 @@ def test_sp_dropout_statistics(sp_mesh, layer):
     if layer == "zigzag":
         idxs = zigzag_indices(64, 8)
         inv = np.argsort(idxs)
-        mapped = _sm()(
+        mapped = shard_map_unchecked(
             lambda q, k, v, seed: zigzag_ring_attention(
                 q, k, v, axis_name="sp", use_flash=True,
                 block_q=4, block_k=4,
@@ -1055,7 +1019,6 @@ def test_sp_dropout_statistics(sp_mesh, layer):
             mesh=sp_mesh,
             in_specs=(P(None, "sp"),) * 3 + (P(),),
             out_specs=P(None, "sp"),
-            check_vma=False,
         )
         jitted = jax.jit(mapped)
 
@@ -1070,7 +1033,7 @@ def test_sp_dropout_statistics(sp_mesh, layer):
             schedule="zigzag", block_q=4, block_k=4,
         )(q, k, v))
     else:
-        mapped = _sm()(
+        mapped = shard_map_unchecked(
             lambda q, k, v, seed: ulysses_attention(
                 q, k, v, axis_name="sp", causal=True, use_flash=True,
                 dropout_rate=rate, dropout_seed=seed,
@@ -1078,7 +1041,6 @@ def test_sp_dropout_statistics(sp_mesh, layer):
             mesh=sp_mesh,
             in_specs=(P(None, "sp"),) * 3 + (P(),),
             out_specs=P(None, "sp"),
-            check_vma=False,
         )
         jitted = jax.jit(mapped)
 
@@ -1156,14 +1118,13 @@ def test_sp_dropout_wrappers(sp_mesh):
         x, train=True,
     )
 
-    mapped = _sm()(
+    mapped = shard_map_unchecked(
         lambda v_, xx, key: model.apply(
             v_, xx, train=True, rngs={"dropout": key}
         ),
         mesh=sp_mesh,
         in_specs=(P(), P(None, "sp"), P()),
         out_specs=P(None, "sp"),
-        check_vma=False,
     )
     out = jax.jit(mapped)(variables, x, jax.random.PRNGKey(2))
     assert np.all(np.isfinite(np.asarray(out)))

@@ -212,6 +212,94 @@ def test_midflight_join_zero_retrace(model, engine_factory):
         compileplane.set_compile_monitor(None)
 
 
+def test_flash_decode_bit_identical_with_midflight_join(model, engine_factory):
+    """Kernel plane (ISSUE 19): ``attention="flash"`` routes every
+    decode attend through the Pallas kernel (interpret mode on CPU),
+    reading K/V gathered through the paged block table. The greedy
+    token streams must stay bit-identical to ``generate()`` on the
+    naive path, and a mid-flight join must still cost zero steady-state
+    retraces — the kernel swap must not perturb the PR 13 contract."""
+    lm, variables = model
+    mon = compileplane.CompileMonitor()
+    compileplane.set_compile_monitor(mon)
+    try:
+        eng = engine_factory(slots=2, attention="flash")
+        assert eng.attention == "flash"
+        eng.warmup(prompt_lengths=(5, 9))
+        mon.observe_flush()  # warmup boundary
+        rng = np.random.default_rng(3)
+        first = eng.submit(_prompt(rng, 9), 10)
+        for _ in range(3):
+            eng.step()
+        late = eng.submit(_prompt(rng, 5), 8)  # joins mid-flight
+        summary = eng.run()
+        assert summary["completed"] == 2
+        info = mon.observe_flush()
+        assert info["events"] == 0, f"steady-state compiles: {info}"
+        assert mon.retraces == []
+        assert eng._decode_step._cache_size() == 1
+        for req, mnew in ((first, 10), (late, 8)):
+            ref = np.asarray(
+                generate(lm, variables, jnp.asarray(req.prompt[None]), mnew)
+            )[0][len(req.prompt):]
+            np.testing.assert_array_equal(
+                np.asarray(req.tokens, np.int32), ref
+            )
+    finally:
+        compileplane.set_compile_monitor(None)
+
+
+def test_flash_decode_masks_trash_block_garbage(model, engine_factory):
+    """The segment-ids mask doubles as the padding/alias mask over the
+    block-table-gathered cache: every gathered row past a request's
+    cache index — trash-block rows included — lands in segment 0 and
+    must not contaminate the output. Poison the reserved trash block
+    (block 0) with large finite garbage (stale K/V is what it really
+    holds after warmup); greedy streams must stay bit-identical to
+    ``generate()``, which never sees a paged pool at all. (The sharper
+    NaN variant that PROVES fully-masked tiles skip compute lives at
+    the adapter level: test_ops.py
+    test_flash_fn_decode_prefix_mask_skips_garbage_tiles.)"""
+    lm, variables = model
+    eng = engine_factory(slots=2, attention="flash")
+    eng.warmup(prompt_lengths=(4, 6))
+    poison = jnp.full_like(eng.cache.k_pool[:, 0], 1e6)
+    eng.cache.k_pool = eng.cache.k_pool.at[:, 0].set(poison)
+    eng.cache.v_pool = eng.cache.v_pool.at[:, 0].set(poison)
+    rng = np.random.default_rng(11)
+    # plen + max_new <= 2 blocks each: most of every gathered row is
+    # trash-block garbage.
+    reqs = [(eng.submit(_prompt(rng, plen), mnew), plen, mnew)
+            for plen, mnew in ((4, 6), (6, 4), (5, 8))]
+    summary = eng.run()
+    assert summary["completed"] == len(reqs)
+    for req, plen, mnew in reqs:
+        toks = np.asarray(req.tokens, np.int32)
+        assert np.all(toks >= 0) and np.all(toks < 32)
+        ref = np.asarray(
+            generate(lm, variables, jnp.asarray(req.prompt[None]), mnew)
+        )[0][plen:]
+        np.testing.assert_array_equal(toks, ref)
+
+
+def test_engine_attention_option_validation(model):
+    """The attention option's error paths: an unknown mode raises, a
+    model without the switch raises a named error, and the env-var
+    default (FLUXMPI_TPU_SERVING_ATTENTION) reaches the engine."""
+    lm, variables = model
+    with pytest.raises(ValueError, match="naive.*flash.*auto"):
+        InferenceEngine(lm, variables, slots=2, block_size=8,
+                        attention="fast")
+    os.environ["FLUXMPI_TPU_SERVING_ATTENTION"] = "naive"
+    try:
+        eng = InferenceEngine(lm, variables, slots=2, block_size=8)
+        assert eng.attention == "naive"
+        eng.close()
+    finally:
+        del os.environ["FLUXMPI_TPU_SERVING_ATTENTION"]
+    serving.shutdown()
+
+
 def test_warmup_touches_only_the_trash_block(model, engine_factory):
     eng = engine_factory()
     free_before = eng.cache.free_blocks
