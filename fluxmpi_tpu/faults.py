@@ -28,8 +28,17 @@ site                  where it fires
 ``ckpt.commit``       between the manifest write and the COMMIT marker
                       (simulates a crash that leaves an uncommitted step)
 ``ckpt.read``         :func:`~fluxmpi_tpu.utils.checkpoint.restore_checkpoint`
+``ckpt.snapshot``     the donation-safe device→copy snapshot an async save
+                      takes on the driver thread before handing off
+``ckpt.async_write``  each background-writer save attempt (pair with
+                      ``delay=`` to stall the writer and prove the driver
+                      keeps stepping — the zero-downtime chaos probe)
 ``elastic.restore``   the explicit elastic restore path (``mesh=``/``rule=``
                       template building, before any bytes move)
+``resize.drain``      the live-resize drain step, after the resize request
+                      is agreed and before the final save
+``resize.reshard``    the resumed world's resize restore, before the
+                      manifest-remapped bytes move
 ``serving.admit``     :meth:`fluxmpi_tpu.serving.InferenceEngine.submit`
                       (the admission-control entry — a crash there is a
                       rejected/failed submission, not a dead engine)
@@ -144,7 +153,11 @@ KNOWN_SITES = frozenset(
         "ckpt.manifest",
         "ckpt.commit",
         "ckpt.read",
+        "ckpt.snapshot",
+        "ckpt.async_write",
         "elastic.restore",
+        "resize.drain",
+        "resize.reshard",
         "serving.admit",
         "serving.decode",
     }

@@ -1,0 +1,13 @@
+"""Fleet operations: coordinated actions ACROSS the hosts of one job.
+
+The :mod:`fluxmpi_tpu.telemetry.fleet` plane *observes* a fleet (the
+cross-host collector and straggler attribution); this package *operates*
+on one. Its first citizen is :mod:`~fluxmpi_tpu.fleet.resize` — live
+N→M world resizing: drain at a window boundary, bank a checkpoint,
+restart under the new process count, reshard via the topology manifest,
+and account every second of the pipeline as attributed badput.
+"""
+
+from . import resize  # noqa: F401
+
+__all__ = ["resize"]

@@ -374,6 +374,20 @@ def train_loop(
     cleanly with ``summary["preempted"] = True`` — a
     ``train.preemption`` instant lands on the trace timeline.
 
+    Live resize: with the resize plane armed (``init(resize=...)`` /
+    ``FLUXMPI_TPU_RESIZE``) and a ``checkpoint`` attached, each flush
+    boundary also polls :mod:`fluxmpi_tpu.fleet.resize` — a
+    ``request_resize(M)`` on ANY process is agreed world-wide by one
+    host max-reduce (the coordinated-preemption pattern), after which
+    the loop drains, banks a final checkpoint (waiting out any
+    in-flight async save), writes the resize handoff stamp next to it,
+    and returns with ``summary["resized_to"] = M``. Relaunching under M
+    processes with ``resume=True`` reshards via the topology manifest
+    (sample-exact, the elastic-resume contract), stitches the
+    drain/save/reshard/restart badput record
+    (``fluxmpi_tpu.resize/v1``), and continues. See
+    docs/fault_tolerance.md, "Zero-downtime ops".
+
     Device plane: with a
     :class:`~fluxmpi_tpu.telemetry.CompileMonitor` installed
     (``init(compileplane=True)`` / ``FLUXMPI_TPU_COMPILEPLANE=1``) the
@@ -496,6 +510,7 @@ def train_loop(
     from ..telemetry import fleet as _fleet
     from ..telemetry import goodput as _goodput
     from ..telemetry import modelstats as _modelstats
+    from ..fleet import resize as _resize
     from .train import _DEFAULT_REGISTRY
 
     # Run-health + device planes, resolved ONCE per run (the
@@ -542,6 +557,16 @@ def train_loop(
     # exporter, nothing to scrape), costs one dict merge per flush,
     # nothing per step; fully off it is one module attribute read here.
     fl_on = exp_on and _fleet.enabled()
+    # Live-resize plane: when armed (init(resize=)/FLUXMPI_TPU_RESIZE —
+    # SPMD-consistent like the others) AND a checkpoint manager is
+    # attached (there is nothing to hand off otherwise), each flush
+    # polls the coordinator's request flag exactly like coordinated
+    # preemption: one host max-reduce of the target world size, so any
+    # process's request_resize() enrolls the whole world at the SAME
+    # update count. Off, this is one module attribute read per run.
+    rz = _resize.get_resize_coordinator()
+    rz_on = rz.enabled and checkpoint is not None
+    resize_to: int | None = None
     if cp_on:
         # Tag the hot step for retrace attribution: its jit-cache growth
         # after the warmup boundary names it in the steady_state_retrace
@@ -701,6 +726,17 @@ def train_loop(
             restore_kwargs = {"manifest": manifest}
         else:
             restore_kwargs = {}
+        # A pending resize handoff stamp means this resume IS the
+        # reshard phase of a live resize: fire its chaos site, time the
+        # restore, and stitch the cross-restart badput record once the
+        # state is back.
+        ckpt_dir = getattr(checkpoint, "directory", None)
+        resize_stamp = (
+            rz.maybe_begin_reshard(ckpt_dir)
+            if rz_on and ckpt_dir is not None
+            else None
+        )
+        t_reshard0 = time.perf_counter()
         try:
             ckpt_step, restored = checkpoint.restore(
                 _payload(state, legacy_loader=manifest is None),
@@ -780,6 +816,13 @@ def train_loop(
                     batches.load_state_dict(seat)
                 resume_offset = batches.resume_cursor // k
             resumed_from = ckpt_step
+            if resize_stamp is not None:
+                rz.complete(
+                    ckpt_dir,
+                    resize_stamp,
+                    reshard_seconds=time.perf_counter() - t_reshard0,
+                    to_processes=jax.process_count(),
+                )
             if record_metrics:
                 registry = _live_registry()
                 if registry is not None:
@@ -826,7 +869,7 @@ def train_loop(
         a pending preemption (whose emergency save then has nothing
         left to write). In fused mode every window boundary is a flush
         boundary, so all of this runs once per window."""
-        nonlocal done, preempted
+        nonlocal done, preempted, resize_to
         if at_flush:
             flush()
             if halt_rule is not None:
@@ -865,6 +908,21 @@ def train_loop(
         elif preemption_requested():
             preempted = True
             done = True
+        if rz_on and at_flush and resize_to is None:
+            # Same shape as the preemption poll: every process reaches
+            # this flush at the same updates count, so a host max-reduce
+            # of the requested target (0 = none) agrees one resize for
+            # the whole world. rz_on requires a checkpoint, so multi
+            # implies coordinate — no process skips the collective.
+            target = rz.requested_target()
+            if multi:
+                target = int(
+                    _comm.host_allreduce(np.int32(target), op="max")
+                )
+            if target:
+                resize_to = target
+                rz.begin(target, from_processes=jax.process_count())
+                done = True
 
     lbs_fused = batches.local_batch_size if fused_w else 0
     gbs_fused = batches.global_batch_size if fused_w else 0
@@ -1370,6 +1428,10 @@ def train_loop(
             exc, _live_registry() if record_metrics else None
         )
         raise
+    if resize_to is not None:
+        # The drain ended at the block_until_ready/flush above — close
+        # the drain phase before any save work muddies it.
+        rz.note_drained()
     if preempted:
         # Drained and flushed: bank the final boundary and exit cleanly.
         # The trace instant is the preemption event the schema validates.
@@ -1378,14 +1440,34 @@ def train_loop(
             checkpoint is not None
             and updates > last_saved
             and halt_rule is None
+            and resize_to is None
         ):
             # Past the epoch-accounting block: a completed pass is
             # already in epochs_done. A halt-policy anomaly (set at the
             # stopping flush, or by the final post-drain flush above)
             # gates the emergency save like the periodic ones — a
             # preemption coinciding with a NaN must not make the
-            # diverged state the newest restorable checkpoint.
+            # diverged state the newest restorable checkpoint. A live
+            # resize defers to its own timed save below (a SIGTERM with
+            # a resize target armed is a resize, not a plain
+            # preemption).
             _save_ckpt(pass_counted=True)
+    if resize_to is not None:
+        # The resize's final save — timed end to end (including the
+        # wait for any in-flight async writer) as the record's ``save``
+        # phase, then the handoff stamp banks this world's half next to
+        # the checkpoint for the resumed world to stitch.
+        t_save = time.perf_counter()
+        if updates > last_saved and halt_rule is None:
+            _save_ckpt(pass_counted=True)
+        checkpoint.wait_until_finished()
+        rz.note_phase("save", time.perf_counter() - t_save)
+        rz.write_handoff(
+            getattr(checkpoint, "directory", "."),
+            step=last_saved,
+            from_processes=jax.process_count(),
+            to_processes=resize_to,
+        )
     if checkpoint is not None:
         checkpoint.wait_until_finished()
     seconds = time.perf_counter() - t_start
@@ -1406,6 +1488,7 @@ def train_loop(
         "examples_per_sec": examples / seconds if seconds > 0 else 0.0,
         "loss": loss,
         "preempted": preempted,
+        "resized_to": resize_to,
         "resumed_from": resumed_from,
         "anomaly": halt_rule,
         # Host dispatches of the compiled hot/window program — the
@@ -1433,9 +1516,13 @@ def train_loop(
         # stale "running").
         exporter.note_status(
             phase=(
-                "preempted"
-                if preempted
-                else ("halted" if halt_rule else "finished")
+                "resizing"
+                if resize_to is not None
+                else (
+                    "preempted"
+                    if preempted
+                    else ("halted" if halt_rule else "finished")
+                )
             ),
             updates=updates,
             examples=examples,

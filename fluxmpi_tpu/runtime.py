@@ -377,6 +377,7 @@ def init(
     serving: Any = None,
     request_log: Any = None,
     fleet: Any = None,
+    resize: Any = None,
 ) -> Mesh:
     """Bring up the fluxmpi_tpu runtime. Idempotent.
 
@@ -542,6 +543,16 @@ def init(
         ``FLUXMPI_TPU_FLEET`` (+ ``_FLEET_HOSTS`` / ``_FLEET_INTERVAL``);
         ``False`` resets (collector stopped). See docs/observability.md
         "Fleet plane".
+      resize: arm the live-resize plane
+        (:mod:`fluxmpi_tpu.fleet.resize`) — ``True``/``"1"`` arms it, a
+        path string also banks one ``fluxmpi_tpu.resize/v1`` record per
+        completed resize there, or pass a
+        :class:`~fluxmpi_tpu.fleet.resize.ResizeCoordinator`. ``None``
+        defers to ``FLUXMPI_TPU_RESIZE``; ``False`` disarms. With the
+        plane armed and ``train_loop(checkpoint=...)`` attached,
+        ``fluxmpi_tpu.fleet.resize.request_resize(M)`` drains the world
+        at a flush boundary and hands off to an M-process relaunch.
+        See docs/fault_tolerance.md "Zero-downtime ops".
 
     Returns:
       The global :class:`jax.sharding.Mesh`.
@@ -560,6 +571,7 @@ def init(
     from .utils import profiling as _profiling
     from . import faults as _faults_mod
     from . import serving as _serving
+    from .fleet import resize as _resize
     from .serving import observe as _serving_observe
 
     # parallel="auto" (or FLUXMPI_TPU_PARALLEL=auto with no explicit
@@ -618,6 +630,7 @@ def init(
         _serving.configure(serving)
         _serving_observe.configure(request_log)
         _fleet.configure(fleet)
+        _resize.configure(resize)
         if auto_requested:
             _state.auto_parallel = True
         if verbose:
@@ -633,6 +646,15 @@ def init(
         # loud: silently degrading a pod slice to independent single-process
         # worlds would train without gradient sync and produce wrong results.
         try:
+            # CPU worlds need the gloo collectives opt-in BEFORE the
+            # backend client exists, or every cross-process device
+            # computation fails with "Multiprocess computations aren't
+            # implemented on the CPU backend" (no-op on TPU/GPU).
+            from .parallel._compat import (
+                enable_cpu_cross_process_collectives,
+            )
+
+            enable_cpu_cross_process_collectives()
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,
                 num_processes=num_processes,
@@ -724,6 +746,7 @@ def init(
     # this host's own live exporter when FLUXMPI_TPU_FLEET_HOSTS is
     # unset, so the exporter must already be resolved.
     _fleet.configure(fleet)
+    _resize.configure(resize)
     if _state.plan is not None:
         # PARALLEL board: the resolved mesh/axis sizes land on /status
         # and the parallel.* gauges the moment the plan is installed

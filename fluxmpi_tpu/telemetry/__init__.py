@@ -323,6 +323,15 @@ def shutdown() -> None:
     except Exception:
         pass
     try:
+        # Alongside the fleet observer: a resize request left armed
+        # across init cycles would drain the NEXT run at its first
+        # flush boundary.
+        from ..fleet import resize as _resize
+
+        _resize.shutdown()
+    except Exception:
+        pass
+    try:
         export.shutdown()
     except Exception:
         pass

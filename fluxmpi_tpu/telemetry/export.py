@@ -370,6 +370,8 @@ class Exporter:
         self._parallel: dict[str, Any] = {}
         self._fleet: dict[str, Any] = {}
         self._autotune: dict[str, Any] = {}
+        self._checkpoint: dict[str, Any] = {}
+        self._resize: dict[str, Any] = {}
         self._status_lock = threading.Lock()
         # Progress plateau tracking (the watchdog's check() shape,
         # evaluated lazily per health request instead of on a poll
@@ -514,6 +516,29 @@ class Exporter:
             self._autotune.update(fields)
             self._autotune["noted_unix"] = time.time()
 
+    def note_checkpoint(self, **fields: Any) -> None:
+        """Merge ``fields`` into the ``checkpoint`` section of
+        ``/status`` — the CHECKPOINT board (last committed step and its
+        tier, whether async saves are on, the in-flight background
+        save's step and start stamp, the superseded-request count),
+        posted by :class:`~fluxmpi_tpu.utils.checkpoint.CheckpointManager`
+        after every save request and writer completion.
+        ``scripts/fluxmpi_top.py`` renders it as the CHECKPOINT view."""
+        with self._status_lock:
+            self._checkpoint.update(fields)
+            self._checkpoint["noted_unix"] = time.time()
+
+    def note_resize(self, **fields: Any) -> None:
+        """Merge ``fields`` into the ``resize`` section of ``/status``
+        — the RESIZE board (requested world size, current phase of the
+        drain→save→reshard→restart pipeline, per-phase badput seconds
+        so far), posted by :mod:`fluxmpi_tpu.fleet.resize` as a live
+        resize progresses. ``scripts/fluxmpi_top.py`` renders it as the
+        RESIZE view."""
+        with self._status_lock:
+            self._resize.update(fields)
+            self._resize["noted_unix"] = time.time()
+
     def clear_status(self) -> None:
         with self._status_lock:
             self._status.clear()
@@ -522,6 +547,8 @@ class Exporter:
             self._parallel.clear()
             self._fleet.clear()
             self._autotune.clear()
+            self._checkpoint.clear()
+            self._resize.clear()
 
     # -- health --------------------------------------------------------
 
@@ -619,6 +646,8 @@ class Exporter:
             parallel = dict(self._parallel) or None
             fleet = dict(self._fleet) or None
             autotune = dict(self._autotune) or None
+            checkpoint = dict(self._checkpoint) or None
+            resize = dict(self._resize) or None
         gp = _goodput.get_goodput_tracker()
         goodput_rep = gp.report() if gp.enabled else None
         det = _anomaly.get_anomaly_detector()
@@ -653,6 +682,8 @@ class Exporter:
             "parallel": parallel,
             "fleet": fleet,
             "autotune": autotune,
+            "checkpoint": checkpoint,
+            "resize": resize,
             "goodput": goodput_rep,
             "anomaly": last_anomaly,
             "monitor": monitor,

@@ -45,6 +45,12 @@ on its hot path. Segments are recorded by ONE driver thread (the first
 to record); other threads' segments are ignored — a background async
 checkpoint save overlaps training and is exactly the badput the async
 path exists to avoid, so counting it would double-book the wall clock.
+Off-driver work that still wants visibility reports through
+:meth:`GoodputTracker.note_background` instead: a separate thread-safe
+ledger (``report()['background']``, ``goodput.background_seconds``
+gauges) outside the wall-clock buckets — the async checkpoint writer
+books its real write cost there, so *driver* ``checkpoint_save`` ≈
+snapshot cost is an assertable contract.
 Nested segments count once (outermost wins), so wrapping a restore in a
 ``resume`` segment never double-counts the inner ``checkpoint_restore``.
 
@@ -174,6 +180,8 @@ class GoodputTracker:
         segment (or :meth:`start_run`) begins a fresh wall-clock window."""
         self._t0: float | None = None
         self._buckets: dict[str, float] = {}
+        self._background: dict[str, float] = {}
+        self._background_lock = threading.Lock()
         self._updates = 0
         self._flops_per_update: float | None = None
         self._depth = 0
@@ -219,6 +227,22 @@ class GoodputTracker:
         elif self._thread != threading.get_ident():
             return
         self._add(name, seconds)
+
+    def note_background(self, name: str, seconds: float) -> None:
+        """Attribute ``seconds`` of OFF-driver work to the background
+        ledger ``name`` (async checkpoint writer, prefetcher). Background
+        time overlaps the driver's wall clock, so it is kept out of the
+        badput buckets — ``report()['buckets']`` still sums to the wall —
+        but it is the number that proves the async path moved the cost
+        off the driver: driver-thread ``checkpoint_save`` ≈ snapshot,
+        ``background['checkpoint_async_write']`` ≈ the real write.
+        Thread-safe (it exists for non-driver threads)."""
+        if not self.enabled:
+            return
+        with self._background_lock:
+            self._background[name] = (
+                self._background.get(name, 0.0) + seconds
+            )
 
     def note_updates(self, n: int) -> None:
         """Count ``n`` completed optimizer updates (the MFU numerator's
@@ -306,9 +330,12 @@ class GoodputTracker:
             buckets.get(PRODUCTIVE_BUCKET, 0.0) / wall if wall > 0 else 0.0
         )
         total_mfu, productive_mfu = self._mfu_pair(wall)
+        with self._background_lock:
+            background = dict(self._background)
         return {
             "wall_seconds": wall,
             "buckets": buckets,
+            "background": background,
             "goodput_fraction": fraction,
             "updates": self._updates,
             "flops_per_update": self._flops_per_update,
@@ -330,6 +357,8 @@ class GoodputTracker:
         rep = self.report()
         for name, seconds in rep["buckets"].items():
             reg.gauge("goodput.bucket_seconds", bucket=name).set(seconds)
+        for name, seconds in rep["background"].items():
+            reg.gauge("goodput.background_seconds", bucket=name).set(seconds)
         reg.gauge("goodput.wall_seconds").set(rep["wall_seconds"])
         reg.gauge("goodput.fraction").set(rep["goodput_fraction"])
         reg.gauge("goodput.updates").set(float(rep["updates"]))

@@ -110,6 +110,25 @@ AUTOTUNE_SCHEMA = "fluxmpi_tpu.autotune/v1"
 # (``dominated``). A null ``pruned`` means the candidate ran a trial.
 AUTOTUNE_PRUNE_REASONS = ("memory", "dominated")
 
+# Live N→M resize records (fleet/resize.py): one JSON object per
+# completed resize — the old and new world sizes, the drained step, and
+# the badput seconds attributed to each phase of the
+# drain→save→reshard→restart pipeline. The draining world banks the
+# first half on a handoff stamp next to the checkpoint; the resumed
+# world completes the record and appends it to the
+# ``FLUXMPI_TPU_RESIZE=<path>`` JSONL bank that
+# ``scripts/check_metrics_schema.py`` validates.
+RESIZE_SCHEMA = "fluxmpi_tpu.resize/v1"
+
+# The badput phases of one resize, in pipeline order: finishing the
+# in-flight window after the request is agreed (``drain``), the final
+# synchronous checkpoint save (``save``), the resumed world's
+# manifest-remapped restore (``reshard``), and the wall-clock gap
+# between the old world's exit stamp and the new world's resume
+# (``restart`` — scheduler + process bring-up, the part outside both
+# worlds).
+RESIZE_PHASES = ("drain", "save", "reshard", "restart")
+
 METRIC_TYPES = ("counter", "gauge", "histogram")
 
 _HIST_STAT_KEYS = ("sum", "min", "max", "mean", "last")
@@ -137,6 +156,21 @@ KNOWN_METRIC_NAMES = frozenset(
         "train.resumes",
         "fault.injected",
         "checkpoint.retries",
+        # Zero-downtime ops (PR 20): async-save accounting (driver-side
+        # request counter, coalesced requests superseded by a newer one,
+        # local→durable tier promotions) and the off-driver background
+        # ledger ({bucket=...} — the async writer's real write cost,
+        # kept OUT of the wall-clock badput buckets it overlaps).
+        "checkpoint.async_saves",
+        "checkpoint.async_superseded",
+        "checkpoint.promotions",
+        "goodput.background_seconds",
+        # Live N→M resize (fleet/resize.py): requests agreed by the
+        # world, completed resizes stitched by the resumed world, and
+        # the per-phase badput gauges ({phase=...}, RESIZE_PHASES).
+        "resize.requests",
+        "resize.completed",
+        "resize.badput_seconds",
         # Run-health plane (PR 7): goodput/badput wall-clock accounting
         # (cumulative-seconds gauges labeled {bucket=...}), the
         # productive fraction, live MFU over wall / over productive step
@@ -298,6 +332,7 @@ _CLOSED_NAMESPACES = (
     "parallel.",
     "fleet.",
     "autotune.",
+    "resize.",
 )
 
 # Histogram bucket edges, declared HERE so the registry (which bins
@@ -600,6 +635,8 @@ def validate_status_record(rec: object) -> list[str]:
         "parallel",
         "fleet",
         "autotune",
+        "checkpoint",
+        "resize",
     ):
         v = rec.get(key)
         if v is not None and not isinstance(v, dict):
@@ -614,6 +651,67 @@ def validate_status_record(rec: object) -> list[str]:
             errors.append("health: missing numeric 'seconds_since_progress'")
         if not _is_number(health.get("deadline_seconds")):
             errors.append("health: missing numeric 'deadline_seconds'")
+    return errors
+
+
+def validate_resize_record(rec: object) -> list[str]:
+    """Validate one live-resize event record (schema
+    "fluxmpi_tpu.resize/v1", started by the draining world's handoff
+    stamp and completed by the resumed world —
+    ``fleet/resize.py``); returns a list of error strings (empty ==
+    valid).
+
+    ``phases`` must carry a number >= 0 for every name in
+    :data:`RESIZE_PHASES` — a resize that skipped a phase reports 0.0
+    for it, never omits it (post-mortem tooling sums columns)."""
+    if not isinstance(rec, dict):
+        return [f"resize record is not an object: {type(rec).__name__}"]
+    errors: list[str] = []
+    if rec.get("schema") != RESIZE_SCHEMA:
+        errors.append(
+            f"'schema' must be {RESIZE_SCHEMA!r}, got {rec.get('schema')!r}"
+        )
+    if not _is_number(rec.get("time_unix")):
+        errors.append("missing numeric 'time_unix'")
+    for key in ("from_processes", "to_processes"):
+        v = rec.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+            errors.append(f"'{key}' must be an int >= 1")
+    step = rec.get("step")
+    if not isinstance(step, int) or isinstance(step, bool) or step < 0:
+        errors.append("'step' must be an int >= 0")
+    reason = rec.get("reason")
+    if reason is not None and (not isinstance(reason, str) or not reason):
+        errors.append("'reason' must be null or a non-empty str")
+    phases = rec.get("phases")
+    if not isinstance(phases, dict):
+        errors.append("'phases' must be an object")
+    else:
+        for name in RESIZE_PHASES:
+            v = phases.get(name)
+            if not _is_number(v) or v < 0:
+                errors.append(
+                    f"phases: missing numeric '{name}' >= 0 (every "
+                    f"RESIZE_PHASES entry is required)"
+                )
+        for name in phases:
+            if name not in RESIZE_PHASES:
+                errors.append(
+                    f"phases: unknown phase {name!r} "
+                    f"(must be one of {RESIZE_PHASES})"
+                )
+    total = rec.get("badput_seconds")
+    if not _is_number(total) or total < 0:
+        errors.append("'badput_seconds' must be a number >= 0")
+    elif isinstance(phases, dict) and all(
+        _is_number(phases.get(n)) for n in RESIZE_PHASES
+    ):
+        s = sum(float(phases[n]) for n in RESIZE_PHASES)
+        if abs(s - float(total)) > max(1e-6, 1e-3 * s):
+            errors.append(
+                f"'badput_seconds' ({total}) must equal the sum of "
+                f"'phases' ({s})"
+            )
     return errors
 
 

@@ -79,6 +79,8 @@ __all__ = ["save_checkpoint", "restore_checkpoint", "CheckpointManager"]
 _ENV_TIMEOUT = "FLUXMPI_TPU_CKPT_TIMEOUT"
 _ENV_RETRIES = "FLUXMPI_TPU_CKPT_RETRIES"
 _ENV_BACKOFF = "FLUXMPI_TPU_CKPT_RETRY_BACKOFF_S"
+_ENV_ASYNC = "FLUXMPI_TPU_CKPT_ASYNC"
+_ENV_LOCAL_DIR = "FLUXMPI_TPU_CKPT_LOCAL_DIR"
 _BACKOFF_CAP_S = 5.0
 
 # Injectable sleep (the watchdog's injectable-clock discipline): retry
@@ -355,6 +357,48 @@ def _to_host_template(tree: Any) -> Any:
         return x
 
     return jax.tree_util.tree_map(leaf, tree)
+
+
+def _snapshot_tree(tree: Any) -> Any:
+    """Donation-safe snapshot of ``tree`` for an async save — the ONLY
+    checkpoint cost the training driver pays on the async path (fault
+    site ``ckpt.snapshot``).
+
+    Replicated / host state comes back as the host-numpy template (the
+    PR 5 behavior). Sharded (FSDP/TP) state must never host-gather, so
+    each jax leaf is copied ON DEVICE instead — same sharding, fresh
+    buffers — and blocked until ready, so the caller's next *donating*
+    dispatch cannot tear the bytes out from under the background writer
+    (orbax then reads only this process's shards from the copy)."""
+    _faults.check("ckpt.snapshot")
+    if not _is_sharded_tree(tree):
+        return _to_host_template(tree)
+
+    def leaf(x: Any) -> Any:
+        if isinstance(x, jax.ShapeDtypeStruct):
+            return np.zeros(x.shape, x.dtype)
+        if isinstance(x, jax.Array):
+            return x.copy()
+        return x
+
+    snapshot = jax.tree_util.tree_map(leaf, tree)
+    jax.block_until_ready(
+        [l for l in jax.tree_util.tree_leaves(snapshot)
+         if isinstance(l, jax.Array)]
+    )
+    return snapshot
+
+
+def _note_background_save(seconds: float) -> None:
+    """Book a background writer's wall time with the goodput tracker's
+    off-driver ledger (``report()["background"]``) — the async-save
+    proof surface: driver-thread ``checkpoint_save`` stays ≈ snapshot
+    cost while the real write cost remains observable here."""
+    from ..telemetry import goodput as _goodput
+
+    tracker = _goodput.get_goodput_tracker()
+    if tracker.enabled:
+        tracker.note_background("checkpoint_async_write", seconds)
 
 
 def _place_into(restored: Any, targets: Any) -> Any:
@@ -828,11 +872,31 @@ class CheckpointManager:
       marker, so a torn save is never listed as restorable;
     - **keep-k retention** (``max_to_keep``), oldest deleted after each
       successful save, lead process only;
-    - **async save** (``async_save=True``): replicated state is snapshotted
-      to host up front (donation-safe), then written on a single background
-      thread (order preserved; each entry point waits for the previous
-      save); sharded state always saves synchronously (collective);
-      :meth:`wait_until_finished` joins;
+    - **async save** (``async_save=True`` / ``FLUXMPI_TPU_CKPT_ASYNC``,
+      per-call ``save(async_=...)``): the state is snapshotted up front
+      (donation-safe — replicated state to host, sharded state copied on
+      device, fault site ``ckpt.snapshot``), then a single background
+      writer thread runs the full crash-consistent commit protocol
+      (fault site ``ckpt.async_write``). The driver never blocks past
+      the snapshot: overlapping saves **coalesce** — at most one write
+      is in flight, and a newer request supersedes any queued one
+      (``checkpoint.async_superseded``); a background failure is stored
+      and re-raised at the next ``save``/``wait_until_finished``/
+      ``restore``/``close`` — it can never strand peers mid-protocol
+      beyond what the peer-sentinel abort already handles, and it never
+      corrupts the last committed step. :meth:`wait_until_finished`
+      joins;
+    - **multi-tier retention** (``local_dir=`` /
+      ``FLUXMPI_TPU_CKPT_LOCAL_DIR``): saves commit to a local-disk
+      fast tier first, then a background **promotion** copies the
+      committed artifacts to the durable ``directory`` with the same
+      rename→manifest→marker ordering; the two tiers retain
+      independently (``local_max_to_keep`` / ``max_to_keep``) and
+      discovery/restore prefer the fastest tier holding a committed
+      step. Single-controller worlds only: per-host local disks would
+      break the shared-storage contract the multi-process commit
+      protocol relies on, so multi-process runs warn once and use the
+      durable tier alone;
     - **resume discovery**: :meth:`latest_step` / :meth:`restore` with
       ``step=None`` find the newest complete checkpoint;
     - **partial quarantine**: startup sweeps the directory for
@@ -856,30 +920,63 @@ class CheckpointManager:
         directory: str,
         *,
         max_to_keep: int | None = 3,
-        async_save: bool = True,
+        async_save: bool | None = None,
+        local_dir: str | None = None,
+        local_max_to_keep: int | None = 2,
     ):
         self.directory = os.path.abspath(directory)
         self.max_to_keep = max_to_keep
+        if async_save is None:
+            async_save = os.environ.get(_ENV_ASYNC, "") != "0"
+        self._async = bool(async_save)
+        if local_dir is None:
+            local_dir = os.environ.get(_ENV_LOCAL_DIR) or None
+        if local_dir is not None and jax.process_count() > 1:
+            # Per-host local disks break the shared-storage contract the
+            # multi-process commit protocol (lead-only marker, peer
+            # sentinels, discovery) relies on.
+            warnings.warn(
+                "CheckpointManager local_dir fast tier is single-"
+                "controller only; multi-process runs use the durable "
+                "tier alone",
+                stacklevel=2,
+            )
+            local_dir = None
+        self.local_dir = (
+            os.path.abspath(local_dir) if local_dir is not None else None
+        )
+        self.local_max_to_keep = local_max_to_keep
         os.makedirs(self.directory, exist_ok=True)
         self.quarantined = self._quarantine_partials()
-        self._executor = (
-            ThreadPoolExecutor(max_workers=1, thread_name_prefix="ckpt")
-            if async_save
-            else None
-        )
-        self._pending: Future | None = None
+        if self.local_dir is not None:
+            os.makedirs(self.local_dir, exist_ok=True)
+            self.quarantined += self._quarantine_partials(self.local_dir)
+        self._executor: ThreadPoolExecutor | None = None
+        # Async coalescing state, all under _lock: the in-flight writer
+        # future (its writer drains _queued before completing, so ONE
+        # wait covers every accepted request), the one queued (step,
+        # snapshot, force) slot a newer request supersedes, and the
+        # stored failure of a finished background write.
+        self._inflight: Future | None = None
+        self._queued: tuple[int, Any, bool] | None = None
+        self._async_error: BaseException | None = None
+        self.superseded = 0
+        self._inflight_step: int | None = None
+        self._inflight_since: float | None = None
+        self._last_committed: tuple[int, str] | None = None
         self._lock = threading.Lock()
 
-    def _quarantine_partials(self) -> list[str]:
+    def _quarantine_partials(self, directory: str | None = None) -> list[str]:
         """Move uncommitted step dirs / stale staging dirs into
         ``_quarantine/`` (lead process; barrier'd so no peer races a
         restore against the sweep). Returns the quarantined names."""
+        directory = self.directory if directory is None else directory
         moved: list[str] = []
         removed: list[str] = []
         if jax.process_index() == 0:
-            qdir = os.path.join(self.directory, "_quarantine")
-            for name in sorted(os.listdir(self.directory)):
-                full = os.path.join(self.directory, name)
+            qdir = os.path.join(directory, "_quarantine")
+            for name in sorted(os.listdir(directory)):
+                full = os.path.join(directory, name)
                 if not os.path.exists(full):
                     # Moved along with its step dir earlier this sweep
                     # (a partial dir's manifest sibling).
@@ -939,7 +1036,7 @@ class CheckpointManager:
                     "mid-save; the newest COMMITTED step is unaffected",
                     stacklevel=3,
                 )
-        _process_barrier(f"ckpt_quarantine:{self.directory}")
+        _process_barrier(f"ckpt_quarantine:{directory}")
         return moved + removed
 
     def _check_step_agreement(self, step: int) -> None:
@@ -966,106 +1063,287 @@ class CheckpointManager:
             f"desync)"
         )
 
-    def _step_path(self, step: int) -> str:
-        return os.path.join(self.directory, f"step_{step:08d}")
+    def _step_path(self, step: int, directory: str | None = None) -> str:
+        return os.path.join(
+            self.directory if directory is None else directory,
+            f"step_{step:08d}",
+        )
 
-    def all_steps(self) -> list[int]:
-        """Steps with *complete* checkpoints (layout marker present),
-        ascending."""
+    @staticmethod
+    def _steps_in(directory: str) -> list[int]:
         steps = []
         try:
-            names = os.listdir(self.directory)
+            names = os.listdir(directory)
         except FileNotFoundError:
             return []
         for name in names:
             m = _STEP_DIR_RE.match(name)
             if m and _read_layout_marker(
-                os.path.join(self.directory, name)
+                os.path.join(directory, name)
             ) is not None:
                 steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def all_steps(self) -> list[int]:
+        """Steps with *complete* checkpoints (layout marker present) in
+        ANY tier, ascending — a step committed locally but not yet
+        promoted is restorable and counts."""
+        steps = set(self._steps_in(self.directory))
+        if self.local_dir is not None:
+            steps |= set(self._steps_in(self.local_dir))
         return sorted(steps)
 
     def latest_step(self) -> int | None:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
-    def save(self, step: int, state: Any, *, force: bool = True) -> None:
-        """Checkpoint ``state`` as ``step``; with ``async_save`` only the
-        disk write runs in the background.
+    def tier_of(self, step: int) -> str | None:
+        """Which tier a restore of ``step`` would read: ``"local"``
+        (fast tier holds the committed step) beats ``"durable"``; None
+        when no tier has it committed."""
+        if self.local_dir is not None and _read_layout_marker(
+            self._step_path(step, self.local_dir)
+        ) is not None:
+            return "local"
+        if _read_layout_marker(self._step_path(step)) is not None:
+            return "durable"
+        return None
 
-        Replicated state is snapshotted to host *synchronously* first:
-        compiled train steps donate their input buffers by default, so the
-        caller's next ``step(state, …)`` would tear the device buffers out
-        from under a background ``device_get``. Sharded (FSDP/TP) state
-        cannot be host-snapshotted without gathering, so its save runs
-        synchronously (orbax still writes only per-process shards).
+    def _tier_path(self, step: int) -> str:
+        """The restore path for ``step``: the fastest tier holding a
+        committed copy (restore-side of the multi-tier contract)."""
+        if self.tier_of(step) == "local":
+            return self._step_path(step, self.local_dir)
+        return self._step_path(step)
+
+    def _raise_async_error(self) -> None:
+        with self._lock:
+            err, self._async_error = self._async_error, None
+        if err is not None:
+            raise err
+
+    def save(
+        self,
+        step: int,
+        state: Any,
+        *,
+        force: bool = True,
+        async_: bool | None = None,
+    ) -> None:
+        """Checkpoint ``state`` as ``step``.
+
+        ``async_`` (default: the manager's ``async_save`` setting) picks
+        the path. **Async**: the driver pays ONLY the donation-safe
+        snapshot (replicated state to host, sharded state copied on
+        device — fault site ``ckpt.snapshot``) and returns; a single
+        background writer runs the crash-consistent commit protocol
+        (fault site ``ckpt.async_write``). Overlapping requests
+        coalesce: at most one write is in flight, a newer request
+        supersedes any queued one (its snapshot is dropped, counted in
+        ``checkpoint.async_superseded``), and a stored background
+        failure is re-raised here before a new snapshot is taken.
+        **Sync** (``async_=False``): joins any in-flight write, then
+        saves inline.
 
         Aborts with :class:`~fluxmpi_tpu.errors.CheckpointDesyncError`
         (flight-recorder context dumped) when processes disagree on
         ``step`` — checked on the caller thread, before any bytes move.
 
-        Goodput: the caller-thread cost — agreement check, host
-        snapshot, sync saves, and the throttling wait on the previous
-        async save — books into the ``checkpoint_save`` bucket; the
-        background write itself overlaps training and does not."""
+        Goodput: the caller-thread cost — agreement check, snapshot,
+        and sync saves — books into the ``checkpoint_save`` bucket; the
+        background write overlaps training and books into the tracker's
+        off-driver ``background`` ledger instead (the async zero-
+        downtime proof: driver bucket ≈ snapshot cost)."""
+        use_async = self._async if async_ is None else bool(async_)
         with _goodput_segment("checkpoint_save"):
+            self._raise_async_error()
             self._check_step_agreement(step)
-            if self._executor is None or _is_sharded_tree(state):
+            if not use_async:
                 self.wait_until_finished()
                 self._save_and_retain(step, state, force)
                 return
-            snapshot = _to_host_template(state)
-            # Submit under the lock so wait_until_finished always observes
-            # the newest pending future; the single-worker executor runs
-            # saves in submission order regardless. The wait on the
-            # *previous* save happens OUTSIDE the lock: if a background
-            # save wedges (e.g. one process never reaches a cross-process
-            # barrier), a lock-held wait would deadlock
-            # wait_until_finished behind it too (ADVICE r3). The
-            # post-submit wait still throttles to one queued snapshot and
-            # surfaces the previous save's errors to this caller.
+            snapshot = _snapshot_tree(state)
             with self._lock:
-                prev = self._pending
-                self._pending = self._executor.submit(
-                    self._save_and_retain, step, snapshot, force
-                )
-            if prev is not None:
-                _wait_with_diagnostic(prev, "previous async checkpoint save")
+                if self._executor is None:
+                    self._executor = ThreadPoolExecutor(
+                        max_workers=1, thread_name_prefix="ckpt"
+                    )
+                if self._inflight is not None:
+                    # Coalesce: the writer is busy — park this request
+                    # in the one queued slot, superseding whatever sat
+                    # there (the writer drains the slot before its
+                    # future completes, so no separate wait is needed).
+                    if self._queued is not None:
+                        self.superseded += 1
+                        self._count("checkpoint.async_superseded")
+                    self._queued = (step, snapshot, force)
+                else:
+                    self._inflight_step = step
+                    self._inflight_since = time.time()
+                    self._inflight = self._executor.submit(
+                        self._async_writer, step, snapshot, force
+                    )
+                self._count("checkpoint.async_saves")
+        self._note_board()
+
+    def _async_writer(self, step: int, state: Any, force: bool) -> None:
+        """Background writer: run the commit protocol for the submitted
+        snapshot, then drain the queued slot until it is empty. Never
+        raises — a failure is stored for the next driver-thread entry
+        point (and any queued request is dropped with it: its snapshot
+        was taken under assumptions the failure may have broken)."""
+        while True:
+            t0 = time.perf_counter()
+            try:
+                _faults.check("ckpt.async_write")
+                self._save_and_retain(step, state, force)
+            except BaseException as exc:
+                with self._lock:
+                    self._async_error = exc
+                    self._queued = None
+                    self._inflight = None
+                    self._inflight_step = None
+                    self._inflight_since = None
+                return
+            finally:
+                _note_background_save(time.perf_counter() - t0)
+            with self._lock:
+                if self._queued is None:
+                    self._inflight = None
+                    self._inflight_step = None
+                    self._inflight_since = None
+                    return
+                step, state, force = self._queued
+                self._queued = None
+                self._inflight_step = step
+                self._inflight_since = time.time()
+
+    def _retain(self, directory: str, keep_k: int | None, step: int) -> None:
+        if keep_k is None:
+            return
+        steps = self._steps_in(directory)
+        keep = set(steps[-keep_k:])
+        keep.add(step)
+        if jax.process_index() == 0:
+            for s in steps:
+                if s not in keep:
+                    path = self._step_path(s, directory)
+                    # Marker first: once it is gone the step is
+                    # invisible to discovery even if the rmtree below
+                    # is interrupted (the startup sweep then collects
+                    # the leftover dir and manifest).
+                    try:
+                        os.remove(_layout_marker_path(path))
+                    except FileNotFoundError:
+                        pass
+                    with contextlib.suppress(FileNotFoundError, OSError):
+                        os.remove(_manifest.manifest_path(path))
+                    shutil.rmtree(path, ignore_errors=True)
 
     def _save_and_retain(self, step: int, state: Any, force: bool) -> None:
-        save_checkpoint(self._step_path(step), state, force=force, step=step)
-        if self.max_to_keep is not None:
-            keep = set(self.all_steps()[-self.max_to_keep:])
-            keep.add(step)
-            if jax.process_index() == 0:
-                for s in self.all_steps():
-                    if s not in keep:
-                        path = self._step_path(s)
-                        # Marker first: once it is gone the step is
-                        # invisible to discovery even if the rmtree below
-                        # is interrupted (the startup sweep then collects
-                        # the leftover dir and manifest).
-                        try:
-                            os.remove(_layout_marker_path(path))
-                        except FileNotFoundError:
-                            pass
-                        with contextlib.suppress(FileNotFoundError, OSError):
-                            os.remove(_manifest.manifest_path(path))
-                        shutil.rmtree(path, ignore_errors=True)
+        if self.local_dir is None:
+            save_checkpoint(
+                self._step_path(step), state, force=force, step=step
+            )
+            self._retain(self.directory, self.max_to_keep, step)
+            self._set_committed(step, "durable")
+            return
+        # Fast tier first: the step is restorable the moment the local
+        # commit lands; promotion to durable storage rides the same
+        # (background, under async) writer afterwards.
+        save_checkpoint(
+            self._step_path(step, self.local_dir), state,
+            force=force, step=step,
+        )
+        self._retain(self.local_dir, self.local_max_to_keep, step)
+        self._set_committed(step, "local")
+        self._promote(step)
+        self._retain(self.directory, self.max_to_keep, step)
+
+    def _promote(self, step: int) -> None:
+        """Copy the locally-committed ``step`` into the durable tier
+        with the commit protocol's ordering (stage → rename → manifest →
+        marker), so a crash mid-promotion leaves the durable tier with
+        either the previous committed copy or none — never a torn one."""
+        src = self._step_path(step, self.local_dir)
+        dst = self._step_path(step)
+        tmp = dst + ".tmp"
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp, ignore_errors=True)
+        shutil.copytree(src, tmp)
+        if os.path.exists(dst):
+            try:
+                os.remove(_layout_marker_path(dst))
+            except FileNotFoundError:
+                pass
+            with contextlib.suppress(FileNotFoundError, OSError):
+                os.remove(_manifest.manifest_path(dst))
+            shutil.rmtree(dst, ignore_errors=True)
+        os.rename(tmp, dst)
+        _fsync_dir(os.path.dirname(dst))
+        src_manifest = _manifest.manifest_path(src)
+        if os.path.exists(src_manifest):
+            shutil.copyfile(src_manifest, _manifest.manifest_path(dst))
+        for sidecar in glob.glob(src + ".autotune.json"):
+            shutil.copyfile(sidecar, dst + ".autotune.json")
+        _write_layout_marker(dst, _read_layout_marker(src) or "replicated")
+        self._count("checkpoint.promotions")
+
+    def _count(self, name: str) -> None:
+        registry = _telemetry_registry()
+        if registry is not None and getattr(registry, "enabled", True):
+            registry.counter(name).inc()
+
+    def _set_committed(self, step: int, tier: str) -> None:
+        with self._lock:
+            self._last_committed = (step, tier)
+        self._note_board()
+
+    def _note_board(self) -> None:
+        """Post the CHECKPOINT board to the live exporter (when one is
+        serving): last committed step + tier, and the in-flight async
+        save's step/age. A dict merge under the exporter's lock — the
+        zero-cost-when-off contract: no exporter, no calls."""
+        from ..telemetry import export as _export
+
+        exporter = _export.get_exporter()
+        if exporter is None or not exporter.enabled:
+            return
+        with self._lock:
+            committed = self._last_committed
+            fields: dict[str, Any] = {
+                "last_committed_step": committed[0] if committed else None,
+                "tier": committed[1] if committed else None,
+                "async": self._async,
+                "inflight_step": self._inflight_step,
+                "inflight_since_unix": self._inflight_since,
+                "superseded": self.superseded,
+            }
+        exporter.note_checkpoint(**fields)
 
     def wait_until_finished(self) -> None:
-        """Block until any in-flight async save has committed. The wait
-        is host time spent on checkpointing — goodput ``checkpoint_save``
+        """Block until any in-flight async save (queued requests
+        included — the writer drains them under the same future) has
+        committed; re-raises a stored background failure. The wait is
+        host time spent on checkpointing — goodput ``checkpoint_save``
         badput (no-op booking when nothing is pending or the tracker is
         off)."""
-        with self._lock:
-            pending = self._pending
-            self._pending = None
-        if pending is not None:
+        while True:
+            with self._lock:
+                pending = self._inflight
+            if pending is None:
+                break
             with _goodput_segment("checkpoint_save"):
                 _wait_with_diagnostic(
                     pending, "in-flight async checkpoint save"
                 )
+            with self._lock:
+                if self._inflight is pending:
+                    # The writer clears this itself on its way out; a
+                    # future that failed at submission time would spin
+                    # here forever without the fallback clear.
+                    self._inflight = None
+        self._raise_async_error()
 
     def read_manifest(self, step: int | None = None) -> dict[str, Any] | None:
         """The topology manifest of ``step`` (default: latest complete
@@ -1077,7 +1355,7 @@ class CheckpointManager:
             step = self.latest_step()
             if step is None:
                 return None
-        return _manifest.read_manifest(self._step_path(step))
+        return _manifest.read_manifest(self._tier_path(step))
 
     def restore(
         self,
@@ -1106,15 +1384,17 @@ class CheckpointManager:
                     f"no complete checkpoint under {self.directory}"
                 )
         return step, restore_checkpoint(
-            self._step_path(step), like,
+            self._tier_path(step), like,
             allow_layout_change=allow_layout_change,
             mesh=mesh, rule=rule, parallel=parallel, manifest=manifest,
         )
 
     def close(self) -> None:
-        self.wait_until_finished()
-        if self._executor is not None:
-            self._executor.shutdown(wait=True)
+        try:
+            self.wait_until_finished()
+        finally:
+            if self._executor is not None:
+                self._executor.shutdown(wait=True)
 
     def __enter__(self) -> "CheckpointManager":
         return self

@@ -16,8 +16,12 @@ Usage:
   ``init(fleet=...)`` / ``FLUXMPI_TPU_FLEET``), which validate as
   fleet snapshots, and lines carrying
   ``"schema": "fluxmpi_tpu.autotune/v1"`` (layout-autotuner records),
-  which validate as autotune records — and a line carrying a ``bench``
-  key must also embed a valid bench record. Metric names in the
+  which validate as autotune records, and lines carrying
+  ``"schema": "fluxmpi_tpu.resize/v1"`` (the live-resize badput bank,
+  ``init(resize=...)`` / ``FLUXMPI_TPU_RESIZE``), which validate as
+  resize records (a number for every ``RESIZE_PHASES`` phase, totals
+  that sum; transient handoff half-records pass untouched) — and a
+  line carrying a ``bench`` key must also embed a valid bench record. Metric names in the
   framework-owned ``fault.`` / ``checkpoint.`` / ``goodput.`` /
   ``anomaly.`` / ``compile.`` / ``memory.`` namespaces must come from
   ``schema.KNOWN_METRIC_NAMES``
@@ -47,6 +51,10 @@ Usage:
   (the ``FLUXMPI_TPU_AUTOTUNE_BANK`` file or a ``<ckpt>.autotune.json``
   sidecar): validated as layout-autotuner records — candidate table
   consistency (pruned ⇒ no trial, trials count, winner trialed).
+- ``*.json`` files carrying ``"schema": "fluxmpi_tpu.resize/v1"``: a
+  completed live-resize record saved whole validates like a bank line;
+  a pending handoff stamp (``.fluxmpi_resize.json``, ``"handoff":
+  true``) passes untouched.
 - other ``*.json`` files: a bench record — either bench.py's raw output
   (``{"metric": ...}``) or a driver BENCH_*.json wrapper whose ``tail``
   holds the JSON line bench.py printed.
@@ -147,6 +155,18 @@ def check_file(path: str, schema) -> list[str]:
                 for e in schema.validate_autotune_record(rec):
                     errors.append(f"{path}:{i}: {e}")
                 continue
+            if (
+                isinstance(rec, dict)
+                and rec.get("schema") == schema.RESIZE_SCHEMA
+            ):
+                # Live-resize event record (the FLUXMPI_TPU_RESIZE
+                # bank). Handoff stamps share the schema tag but are
+                # half-records by design (the resumed world completes
+                # and removes them) — skipped, not failed.
+                if not rec.get("handoff"):
+                    for e in schema.validate_resize_record(rec):
+                        errors.append(f"{path}:{i}: {e}")
+                continue
             for e in schema.validate_record(rec):
                 errors.append(f"{path}:{i}: {e}")
             if isinstance(rec, dict) and "bench" in rec:
@@ -176,6 +196,13 @@ def check_file(path: str, schema) -> list[str]:
         return [
             f"{path}: {e}" for e in schema.validate_autotune_record(data)
         ]
+    if isinstance(data, dict) and data.get("schema") == schema.RESIZE_SCHEMA:
+        # A completed resize record saved whole; pending handoff stamps
+        # (.fluxmpi_resize.json, "handoff": true) are transient
+        # half-records and pass untouched.
+        if data.get("handoff"):
+            return errors
+        return [f"{path}: {e}" for e in schema.validate_resize_record(data)]
     rec = _bench_record_from(data) if isinstance(data, dict) else None
     if rec is None:
         # A wrapper with no bench line is a bench that never ran — not a

@@ -27,7 +27,13 @@ SLO-violation ticker — rendered from the ``serving`` section of the
 same ``/status`` snapshot. With the request-observability plane on
 (``init(request_log=...)``), the ticker adds the live SLO burn rate,
 TTFT p50/p99, the KV high watermark/fragmentation, and the worst
-offenders by TTFT.
+offenders by TTFT. Hosts saving checkpoints get a CHECKPOINT block —
+last committed step and its tier (local/durable), whether async saves
+are on, the in-flight background save's step and age, and the
+superseded-request count; a live N→M resize
+(``fluxmpi_tpu.fleet.resize``) adds a RESIZE block — current pipeline
+phase (drain/save/handoff/reshard/completed), the from→to world sizes,
+and the per-phase badput seconds attributed so far.
 
 Targets are ``host``, ``host:port`` (default port 9307), or full URLs.
 ``--jsonl FILE...`` is the fallback for runs without an exporter: the
@@ -410,6 +416,90 @@ def _autotune_rows(statuses: dict[str, Any]) -> list[str]:
     return rows
 
 
+def _checkpoint_rows(statuses: dict[str, Any]) -> list[str]:
+    """The CHECKPOINT block: one row per host whose ``/status`` carries
+    a ``checkpoint`` board (:class:`CheckpointManager` posts it after
+    every save request and writer completion) — the last committed step
+    and its tier, whether async saves are on, the in-flight background
+    save's step and age, and the superseded-request count (overlapping
+    async requests coalesced away)."""
+    rows: list[str] = []
+    now = time.time()
+    for name, status in statuses.items():
+        board = (status or {}).get("checkpoint")
+        if not isinstance(board, dict):
+            continue
+        if not rows:
+            rows.append(
+                f"{'CHECKPOINT':<18}{'STEP':>8} {'TIER':>8} {'ASYNC':>6}"
+                "  IN-FLIGHT / SUPERSEDED"
+            )
+        inflight_step = board.get("inflight_step")
+        if isinstance(inflight_step, int):
+            detail = f"step {inflight_step}"
+            since = board.get("inflight_since_unix")
+            if isinstance(since, (int, float)):
+                detail += f" ({now - since:.1f}s)"
+        else:
+            detail = "(idle)"
+        superseded = board.get("superseded")
+        if isinstance(superseded, int) and superseded > 0:
+            detail += f"  superseded {superseded}"
+        rows.append(
+            f"{name:<18}"
+            f"{_fmt(board.get('last_committed_step'), '>8.0f'):>8} "
+            f"{board.get('tier') or '-':>8} "
+            f"{'on' if board.get('async') else 'off':>6}  "
+            f"{detail}"
+        )
+    return rows
+
+
+def _resize_rows(statuses: dict[str, Any]) -> list[str]:
+    """The RESIZE block: one row per host whose ``/status`` carries a
+    ``resize`` board (``fluxmpi_tpu.fleet.resize`` posts it as a live
+    N→M resize moves through the drain→save→handoff→reshard pipeline)
+    — the current phase, the from→to world sizes, the boundary step,
+    and the per-phase badput seconds attributed so far."""
+    rows: list[str] = []
+    for name, status in statuses.items():
+        board = (status or {}).get("resize")
+        if not isinstance(board, dict):
+            continue
+        if not rows:
+            rows.append(
+                f"{'RESIZE':<18}{'PHASE':>10} {'WORLD':>8} {'STEP':>8}"
+                "  BADPUT"
+            )
+        frm = board.get("from_processes")
+        to = board.get("to_processes")
+        world = (
+            f"{frm}->{to}"
+            if isinstance(frm, int) and isinstance(to, int)
+            else "-"
+        )
+        phases = board.get("phase_seconds")
+        if isinstance(phases, dict) and phases:
+            badput = " ".join(
+                f"{phase}={seconds:.2f}s"
+                for phase, seconds in phases.items()
+                if isinstance(seconds, (int, float))
+            )
+            total = board.get("badput_seconds")
+            if isinstance(total, (int, float)):
+                badput += f"  total {total:.2f}s"
+        else:
+            badput = "-"
+        rows.append(
+            f"{name:<18}"
+            f"{board.get('phase') or '-':>10} "
+            f"{world:>8} "
+            f"{_fmt(board.get('step'), '>8.0f'):>8}  "
+            f"{badput}"
+        )
+    return rows
+
+
 def _fleet_rows(statuses: dict[str, Any]) -> list[str]:
     """The FLEET block: one row per host whose ``/status`` carries the
     cross-host collector's verdict board (the ``fleet`` section with a
@@ -504,6 +594,8 @@ def render_frame(
     lines.extend(_autotune_rows(statuses))
     lines.extend(_model_rows(statuses))
     lines.extend(_serving_rows(statuses, rates))
+    lines.extend(_checkpoint_rows(statuses))
+    lines.extend(_resize_rows(statuses))
     lines.extend(_fleet_rows(statuses))
     return "\n".join(lines)
 

@@ -163,7 +163,11 @@ def test_checkpoint_manager_async(world, tmp_path):
         for s in range(4):
             mgr.save(s, jax.tree_util.tree_map(lambda x: x * s, state))
         mgr.wait_until_finished()
-        assert mgr.all_steps() == [0, 1, 2, 3]
+        # Overlapping async saves coalesce: a queued intermediate may be
+        # superseded by a newer save, but the latest always commits.
+        steps = mgr.all_steps()
+        assert steps[-1] == 3
+        assert set(steps) <= {0, 1, 2, 3}
         step, restored = mgr.restore(state)
         assert step == 3
         np.testing.assert_allclose(np.asarray(restored["w"]),
@@ -275,7 +279,9 @@ def test_checkpoint_manager_async_survives_donation(world, tmp_path):
             mgr.save(i + 1, saved)
             # next loop iteration donates `state`'s buffers immediately
         mgr.wait_until_finished()
-        assert mgr.all_steps() == [1, 2, 3]
+        # Coalescing may supersede a queued intermediate; the final save
+        # must land, snapshotted before the donating step invalidated it.
+        assert mgr.all_steps()[-1] == 3
         last, restored = mgr.restore(
             replicate(TrainState.create(params, opt), mesh)
         )

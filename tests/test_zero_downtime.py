@@ -1,0 +1,638 @@
+"""Zero-downtime fleet ops: async sharded saves (driver pays only the
+snapshot), multi-tier retention (local fast tier + durable tier), and
+live N→M resize. Fast chaos tests here; the kill-mid-async-write and
+real multi-process resize subprocess variants are at the bottom (the
+resize one slow-marked, the elastic-test discipline)."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import fluxmpi_tpu as fm
+from fluxmpi_tpu import faults
+from fluxmpi_tpu.data import ArrayDataset, DistributedDataLoader
+from fluxmpi_tpu.errors import FaultInjectedError
+from fluxmpi_tpu.fleet import resize as resize_mod
+from fluxmpi_tpu.fleet.resize import ResizeCoordinator, read_handoff
+from fluxmpi_tpu.parallel import TrainState, make_train_step, train_loop
+from fluxmpi_tpu.parallel.train import replicate
+from fluxmpi_tpu.telemetry import goodput as goodput_mod
+from fluxmpi_tpu.telemetry import schema as tschema
+from fluxmpi_tpu.telemetry.goodput import GoodputTracker
+from fluxmpi_tpu.telemetry.watchdog import Watchdog
+from fluxmpi_tpu.utils import CheckpointManager
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_planes():
+    faults.clear()
+    fm.clear_preemption()
+    prev_tracker = goodput_mod.set_goodput_tracker(
+        GoodputTracker(enabled=False)
+    )
+    yield
+    faults.clear()
+    fm.clear_preemption()
+    resize_mod.shutdown()
+    goodput_mod.set_goodput_tracker(prev_tracker)
+
+
+def _state():
+    return {"w": jnp.arange(4.0), "b": jnp.ones((2,))}
+
+
+def _leaves_equal(a, b):
+    jax.tree_util.tree_map(
+        lambda x, y: np.testing.assert_array_equal(
+            np.asarray(jax.device_get(x)), np.asarray(jax.device_get(y))
+        ),
+        a, b,
+    )
+
+
+def _pieces(world, n=128):
+    from fluxmpi_tpu.models import MLP
+
+    model = MLP(features=(16, 1))
+
+    def loss_fn(p, ms, b):
+        bx, by = b
+        return jnp.mean((model.apply(p, bx) - by) ** 2), ms
+
+    opt = optax.adam(1e-3)
+    rng = np.random.default_rng(0)
+    x = rng.uniform(-2, 2, size=(n, 1)).astype(np.float32)
+    params = jax.device_get(
+        model.init(jax.random.PRNGKey(0), jnp.zeros((2, 1)))
+    )
+    ds = ArrayDataset((x, x**2))
+
+    def fresh():
+        return replicate(TrainState.create(params, opt), world)
+
+    def loader():
+        return DistributedDataLoader(ds, 32, mesh=world, shuffle=True,
+                                     seed=7, device_gather=False, prefetch=0)
+
+    return loss_fn, opt, fresh, loader
+
+
+# ---------------------------------------------------------------------------
+# New chaos sites are registered and injectable through the real code paths
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "site",
+    ["ckpt.snapshot", "ckpt.async_write", "resize.drain", "resize.reshard"],
+)
+def test_new_zero_downtime_sites_are_registered(site):
+    assert site in faults.KNOWN_SITES
+
+
+def test_ckpt_snapshot_site_fires_on_driver(world, tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"), async_save=True)
+    with faults.scope("ckpt.snapshot@step=1"):
+        with pytest.raises(FaultInjectedError, match="ckpt.snapshot"):
+            mgr.save(1, _state())
+    # The failed snapshot never reached the writer: nothing committed,
+    # nothing in flight, and the manager is reusable.
+    assert mgr.all_steps() == []
+    mgr.save(1, _state())
+    mgr.close()
+    assert mgr.all_steps() == [1]
+
+
+def test_ckpt_async_write_failure_is_stored_and_reraised(world, tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"), async_save=True)
+    with faults.scope("ckpt.async_write@step=1"):
+        mgr.save(1, _state())  # driver returns: the fault fires off-thread
+        with pytest.raises(FaultInjectedError, match="ckpt.async_write"):
+            mgr.wait_until_finished()
+    # The failure was consumed by the re-raise; the next save is clean.
+    mgr.save(2, _state())
+    mgr.close()
+    assert mgr.all_steps() == [2]
+
+
+def test_resize_drain_site_fires(tmp_path):
+    rc = ResizeCoordinator()
+    with faults.scope("resize.drain@step=1"):
+        with pytest.raises(FaultInjectedError, match="resize.drain"):
+            rc.begin(2, from_processes=1)
+
+
+def test_resize_reshard_site_fires(tmp_path):
+    rc = ResizeCoordinator()
+    rc.begin(1, from_processes=1)
+    rc.note_drained()
+    rc.write_handoff(str(tmp_path), step=3, from_processes=1, to_processes=1)
+    assert read_handoff(str(tmp_path)) is not None
+    with faults.scope("resize.reshard@step=1"):
+        with pytest.raises(FaultInjectedError, match="resize.reshard"):
+            ResizeCoordinator().maybe_begin_reshard(str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# Async saves: bit-identity with sync, driver cost ≈ snapshot, coalescing
+# ---------------------------------------------------------------------------
+
+
+def test_async_save_bit_identical_to_sync(world, tmp_path):
+    """A fused-window run checkpointed asynchronously banks byte-for-byte
+    the same artifacts as the same run checkpointed synchronously — the
+    donation-safe snapshot is a faithful copy of the live state."""
+    loss_fn, opt, fresh, loader = _pieces(world)
+    step = make_train_step(loss_fn, opt, mesh=world)
+    states = {}
+    for mode, async_save in [("sync", False), ("async", True)]:
+        mgr = CheckpointManager(
+            str(tmp_path / mode), async_save=async_save
+        )
+        train_loop(step, fresh(), loader(), steps=6, checkpoint=mgr,
+                   save_every=2, flush_every=2)
+        # The async run may legitimately coalesce an intermediate save
+        # away (a newer request supersedes a queued one); the final
+        # boundary is always committed.
+        assert mgr.all_steps()[-1] == 6
+        # Resume through the loop (0 updates left): the returned state
+        # IS the restored banked step-6 payload.
+        restored, summary = train_loop(step, fresh(), loader(), steps=6,
+                                       checkpoint=mgr, save_every=2,
+                                       flush_every=2, resume=True)
+        mgr.close()
+        assert summary["resumed_from"] == 6 and summary["updates"] == 6
+        states[mode] = restored
+    _leaves_equal(states["sync"], states["async"])
+
+
+def test_async_save_driver_pays_snapshot_only_and_watchdog_stays_green(
+    world, tmp_path
+):
+    """With a ``delay=`` stall injected into the background writer, the
+    driver-thread ``checkpoint_save`` goodput bucket stays ≈ the snapshot
+    cost (far below the stall), the real write cost lands in the
+    off-driver ``background`` ledger, and a watchdog watching driver
+    progress never trips while the slow save is in flight."""
+    delay = 0.6
+    tracker = goodput_mod.set_goodput_tracker(GoodputTracker())
+    tracker = goodput_mod.get_goodput_tracker()
+    mgr = CheckpointManager(str(tmp_path / "ck"), async_save=True)
+    beat = [0]
+    wd = Watchdog(deadline=0.25, dump_dir=str(tmp_path),
+                  sources=[lambda: beat[0]])
+    with faults.scope(f"ckpt.async_write@step=1:delay={delay}"):
+        t0 = time.perf_counter()
+        mgr.save(1, _state())
+        driver_cost = time.perf_counter() - t0
+        assert driver_cost < delay / 2  # never blocked on the stall
+        # The driver keeps making progress while the writer stalls —
+        # the watchdog (and through the same sources, /healthz) stays
+        # green for the whole slow save.
+        deadline = time.time() + delay
+        while time.time() < deadline and mgr.tier_of(1) is None:
+            beat[0] += 1
+            assert wd.check() is None
+            time.sleep(0.02)
+        driver_bucket = tracker.bucket_seconds("checkpoint_save")
+        assert driver_bucket < delay / 2
+        mgr.wait_until_finished()
+    mgr.close()
+    assert mgr.all_steps() == [1]
+    report = tracker.report()
+    # The stalled write's wall time is observable — in the background
+    # ledger, NOT the driver buckets (which still sum to the wall).
+    assert report["background"]["checkpoint_async_write"] >= delay
+    assert report["buckets"]["checkpoint_save"] < delay / 2
+
+
+def test_overlapping_async_saves_coalesce(world, tmp_path):
+    """At most one write in flight; a newer request supersedes the one
+    queued behind it (its snapshot is dropped and counted)."""
+    mgr = CheckpointManager(str(tmp_path / "ck"), async_save=True,
+                            max_to_keep=None)
+    with faults.scope("ckpt.async_write@step=1:delay=0.4"):
+        mgr.save(1, _state())   # writer stalls on the injected delay
+        mgr.save(2, _state())   # parks in the queued slot
+        mgr.save(3, _state())   # supersedes step 2
+        assert mgr.superseded == 1
+        mgr.wait_until_finished()
+    mgr.close()
+    # Step 2 was coalesced away; 1 and 3 committed under one wait.
+    assert mgr.all_steps() == [1, 3]
+
+
+# ---------------------------------------------------------------------------
+# Multi-tier retention
+# ---------------------------------------------------------------------------
+
+
+def test_multi_tier_retention_promotion_and_restore_preference(
+    world, tmp_path
+):
+    durable, local = str(tmp_path / "durable"), str(tmp_path / "local")
+    mgr = CheckpointManager(durable, async_save=False, max_to_keep=2,
+                            local_dir=local, local_max_to_keep=1)
+    saved = {}
+    for step in (1, 2, 3):
+        saved[step] = _state()
+        saved[step]["w"] = saved[step]["w"] + step
+        mgr.save(step, saved[step])
+    mgr.close()
+    # Independent retention: fast tier keeps 1, durable keeps 2; a step
+    # present in ANY tier is restorable.
+    assert mgr.all_steps() == [2, 3]
+    assert mgr.tier_of(3) == "local"     # fastest committed tier wins
+    assert mgr.tier_of(2) == "durable"   # evicted locally, promoted copy
+    assert mgr.tier_of(1) is None
+    step, restored = mgr.restore(_state())
+    assert step == 3
+    _leaves_equal(restored, saved[3])
+    step, restored = mgr.restore(_state(), step=2)
+    _leaves_equal(restored, saved[2])
+    # Promotion ran for every committed step: the durable tier holds the
+    # newest max_to_keep of them on its own, so losing the local disk
+    # loses nothing retained.
+    mgr2 = CheckpointManager(durable, async_save=False)
+    assert mgr2.all_steps() == [2, 3]
+
+
+def test_env_vars_wire_async_and_local_dir(world, tmp_path, monkeypatch):
+    local = str(tmp_path / "fast")
+    monkeypatch.setenv("FLUXMPI_TPU_CKPT_ASYNC", "0")
+    monkeypatch.setenv("FLUXMPI_TPU_CKPT_LOCAL_DIR", local)
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    assert mgr._async is False
+    assert mgr.local_dir == os.path.abspath(local)
+    monkeypatch.setenv("FLUXMPI_TPU_CKPT_ASYNC", "1")
+    monkeypatch.delenv("FLUXMPI_TPU_CKPT_LOCAL_DIR")
+    mgr = CheckpointManager(str(tmp_path / "ck2"))
+    assert mgr._async is True and mgr.local_dir is None
+
+
+# ---------------------------------------------------------------------------
+# Resize coordinator: request plumbing, record validation, in-process loop
+# ---------------------------------------------------------------------------
+
+
+def test_resize_configure_env_and_spec_forms(tmp_path, monkeypatch):
+    assert not resize_mod.enabled()
+    monkeypatch.setenv("FLUXMPI_TPU_RESIZE", "1")
+    assert resize_mod.configure() is not None and resize_mod.enabled()
+    resize_mod.configure(False)
+    assert not resize_mod.enabled()
+    bank = str(tmp_path / "resize.jsonl")
+    rc = resize_mod.configure(bank)
+    assert rc.enabled and rc.log_path == bank
+    with pytest.raises(ValueError, match="resize target"):
+        resize_mod.request_resize(0)
+    resize_mod.request_resize(4, reason="test")
+    assert rc.requested_target() == 4
+    resize_mod.shutdown()
+    # The shutdown no-leak contract: a request must not leak into the
+    # next run's first flush boundary.
+    assert rc.requested_target() == 0 and not resize_mod.enabled()
+
+
+def test_resize_record_schema_validation():
+    rec = {
+        "schema": tschema.RESIZE_SCHEMA,
+        "time_unix": 1.0,
+        "step": 4,
+        "from_processes": 4,
+        "to_processes": 2,
+        "reason": "api",
+        "phases": {"drain": 0.1, "save": 0.5, "reshard": 0.2,
+                   "restart": 0.2},
+        "badput_seconds": 1.0,
+    }
+    assert tschema.validate_resize_record(rec) == []
+    bad = dict(rec, phases={"drain": 0.1}, badput_seconds=0.1)
+    assert tschema.validate_resize_record(bad)
+    bad = dict(rec, badput_seconds=2.0)
+    assert any("sum" in e for e in tschema.validate_resize_record(bad))
+
+
+def test_in_process_resize_round_trip_is_sample_exact(world, tmp_path):
+    """Single-process end-to-end: request → drain at a flush boundary →
+    timed save + handoff stamp → resumed loop resheards, finishes the
+    run, and banks one schema-valid badput record."""
+    bank = str(tmp_path / "resize_bank.jsonl")
+    resize_mod.configure(bank)
+    loss_fn, opt, fresh, loader = _pieces(world)
+    step = make_train_step(loss_fn, opt, mesh=world)
+    ckpt_dir = str(tmp_path / "ck")
+
+    # Uninterrupted reference.
+    ref_state, ref_summary = train_loop(step, fresh(), loader(), steps=8,
+                                        flush_every=2)
+
+    mgr = CheckpointManager(ckpt_dir, async_save=True)
+    resize_mod.request_resize(1, reason="test-shrink")
+    state, summary = train_loop(step, fresh(), loader(), steps=8,
+                                checkpoint=mgr, save_every=100,
+                                flush_every=2)
+    mgr.close()
+    assert summary["resized_to"] == 1
+    assert 0 < summary["updates"] < 8  # drained at a window boundary
+    stamp = read_handoff(ckpt_dir)
+    assert stamp is not None and stamp["handoff"] is True
+    assert stamp["step"] == summary["updates"]
+
+    mgr2 = CheckpointManager(ckpt_dir, async_save=True)
+    state, summary2 = train_loop(step, fresh(), loader(), steps=8,
+                                 checkpoint=mgr2, save_every=100,
+                                 flush_every=2, resume=True)
+    mgr2.close()
+    assert summary2["resumed_from"] == summary["updates"]
+    assert summary2["updates"] == 8
+    assert summary2["resized_to"] is None
+    # The resumed world consumed the stamp: record banked, stamp gone.
+    assert read_handoff(ckpt_dir) is None
+    with open(bank) as f:
+        records = [json.loads(line) for line in f if line.strip()]
+    assert len(records) == 1
+    rec = records[0]
+    assert tschema.validate_resize_record(rec) == []
+    assert rec["from_processes"] == rec["to_processes"] == 1
+    assert rec["reason"] == "test-shrink"
+    assert set(rec["phases"]) == set(tschema.RESIZE_PHASES)
+    assert rec["badput_seconds"] > 0
+    # Sample-exact across the handoff: same final state as the
+    # uninterrupted run (single process: bit-for-bit).
+    _leaves_equal(state.params, ref_state.params)
+
+
+# ---------------------------------------------------------------------------
+# Kill mid-async-write: the previous committed step survives (subprocess)
+# ---------------------------------------------------------------------------
+
+_KILL_CHILD = """
+import os, sys
+ckpt_dir = sys.argv[1]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from fluxmpi_tpu import faults
+from fluxmpi_tpu.utils import CheckpointManager
+
+state = {"w": jnp.arange(4.0), "b": jnp.ones((2,))}
+mgr = CheckpointManager(ckpt_dir, async_save=True)
+mgr.save(1, state)
+mgr.wait_until_finished()  # step 1 committed
+# Stall the next write inside the commit protocol (payload staged,
+# marker not yet written) and hold it there until the kill.
+faults.install("ckpt.commit@step=1:delay=120")
+mgr.save(2, state)
+import time
+while mgr.tier_of(2) is None:
+    print("INFLIGHT", flush=True)
+    time.sleep(0.1)
+"""
+
+
+def test_kill_mid_async_write_previous_step_restorable(world, tmp_path):
+    """SIGKILL a process whose background writer is mid-commit: the torn
+    step is quarantined at the next startup and the previously committed
+    step restores untouched — an async save can never eat the last good
+    checkpoint."""
+    script = tmp_path / "child.py"
+    script.write_text(_KILL_CHILD)
+    ckpt_dir = tmp_path / "ck"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, str(script), str(ckpt_dir)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True,
+    )
+    try:
+        assert proc.stdout.readline().strip() == "INFLIGHT"
+        time.sleep(0.3)  # let the writer sit mid-commit
+        proc.kill()
+        proc.communicate(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    # The torn step-2 artifacts exist but are uncommitted: discovery
+    # never lists them, and the next manager quarantines them away.
+    with pytest.warns(UserWarning, match="quarantined"):
+        mgr = CheckpointManager(str(ckpt_dir), async_save=True)
+    assert any("step_00000002" in name for name in mgr.quarantined)
+    assert mgr.all_steps() == [1]
+    step, restored = mgr.restore(
+        {"w": jnp.zeros(4), "b": jnp.zeros(2)}
+    )
+    assert step == 1
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(restored["w"])), np.arange(4.0)
+    )
+    mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# Real multi-process live resize, 4→2 and 2→4 (slow)
+# ---------------------------------------------------------------------------
+
+_RESIZE_CHILD = """
+import json, os, sys
+coordinator, nprocs, pid, ckpt_dir, log_dir, resize_to = sys.argv[1:7]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+import optax
+import fluxmpi_tpu as fm
+from fluxmpi_tpu.data import (ArrayDataset, DistributedDataContainer,
+                              DistributedDataLoader)
+from fluxmpi_tpu.fleet import resize as flr
+from fluxmpi_tpu.parallel import TrainState, make_train_step, train_loop
+from fluxmpi_tpu.parallel.train import replicate
+from fluxmpi_tpu.utils import CheckpointManager
+from fluxmpi_tpu.models import MLP
+
+bank = os.path.join(log_dir, "resize_bank.jsonl")
+mesh = fm.init(distributed=True, coordinator_address=coordinator,
+               num_processes=int(nprocs), process_id=int(pid),
+               preemption=True, resize=bank)
+
+n = 256
+rng = np.random.default_rng(0)  # same data on every process
+x = rng.uniform(-2, 2, size=(n, 1)).astype(np.float32)
+ids = np.arange(n, dtype=np.int32)
+ds = ArrayDataset((x, x**2, ids))
+
+log = open(os.path.join(log_dir, f"consumed.{nprocs}.{pid}.jsonl"), "a",
+           buffering=1)
+seen = [0]
+
+def track(batch):
+    log.write(json.dumps(np.asarray(batch[2]).tolist()) + "\\n")
+    seen[0] += 1
+    if int(resize_to) and seen[0] == 3:
+        flr.request_resize(int(resize_to), reason="autoscaler")
+    return batch
+
+loader = DistributedDataLoader(
+    DistributedDataContainer(ds), 16, mesh=mesh, shuffle=True, seed=5,
+    elastic_order=True, prefetch=0, device_gather=False, transform=track,
+)
+
+model = MLP(features=(16, 1))
+
+def loss_fn(p, ms, b):
+    bx, by, _ = b
+    return jnp.mean((model.apply(p, bx) - by) ** 2), ms
+
+opt = optax.adam(1e-3)
+params = fm.synchronize(model.init(jax.random.PRNGKey(0), x[:2]))
+state = replicate(TrainState.create(params, opt), mesh)
+step = make_train_step(loss_fn, opt, mesh=mesh)
+mgr = CheckpointManager(ckpt_dir, async_save=False)
+print("READY", flush=True)
+state, summary = train_loop(step, state, loader, epochs=2,
+                            checkpoint=mgr, save_every=100, flush_every=2,
+                            resume=True)
+print("SUMMARY " + json.dumps(
+    {"updates": summary["updates"], "epochs": summary["epochs"],
+     "resized_to": summary["resized_to"], "loss": summary["loss"],
+     "resumed_from": summary["resumed_from"]}), flush=True)
+"""
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_world(script, nprocs, ckpt_dir, log_dir, resize_to):
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return [
+        subprocess.Popen(
+            [sys.executable, str(script), coordinator, str(nprocs), str(i),
+             str(ckpt_dir), str(log_dir), str(resize_to)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+            text=True,
+        )
+        for i in range(nprocs)
+    ]
+
+
+def _drain_world(procs, tag):
+    summaries = []
+    try:
+        for i, p in enumerate(procs):
+            out, _ = p.communicate(timeout=360)
+            assert p.returncode == 0, f"{tag} rank {i}:\n{out}"
+            line = [ln for ln in out.splitlines()
+                    if ln.startswith("SUMMARY ")][-1]
+            summaries.append(json.loads(line[len("SUMMARY "):]))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    return summaries
+
+
+def _consumed_ids(log_dir, nprocs):
+    out = []
+    for i in range(nprocs):
+        p = os.path.join(log_dir, f"consumed.{nprocs}.{i}.jsonl")
+        if not os.path.exists(p):
+            continue
+        with open(p) as f:
+            for line in f:
+                out.extend(json.loads(line))
+    return out
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("n_before,n_after", [(4, 2), (2, 4)])
+def test_live_resize_across_topologies_is_sample_exact(
+    world, tmp_path, n_before, n_after
+):
+    """A mid-epoch ``request_resize(M)`` drains an N-process world at a
+    window boundary, hands off, and the M-process resume finishes the
+    run sample-exact (consumption-log multiset equality against an
+    uninterrupted reference) with one schema-valid badput record in the
+    bank."""
+    script = tmp_path / "child.py"
+    script.write_text(_RESIZE_CHILD)
+
+    # Uninterrupted reference at the BEFORE topology (no resize request).
+    ref_ckpt, ref_logs = tmp_path / "ref_ck", tmp_path / "ref_logs"
+    ref_logs.mkdir()
+    ref_summaries = _drain_world(
+        _spawn_world(script, n_before, ref_ckpt, ref_logs, 0), "ref"
+    )
+    ref_ids = sorted(_consumed_ids(str(ref_logs), n_before))
+    assert len(ref_ids) == 256 * 2  # 2 epochs, no remainder
+
+    # Resizing run: every process requests M after 3 local batches.
+    ckpt, logs = tmp_path / "ck", tmp_path / "logs"
+    logs.mkdir()
+    pre = _drain_world(
+        _spawn_world(script, n_before, ckpt, logs, n_after), "draining"
+    )
+    assert all(s["resized_to"] == n_after for s in pre)
+    banked = pre[0]["updates"]
+    assert 0 < banked < 32  # drained mid-run at a window boundary
+    stamp = read_handoff(str(ckpt))
+    assert stamp is not None and stamp["to_processes"] == n_after
+
+    # Resume at the AFTER topology, same checkpoint directory.
+    post = _drain_world(
+        _spawn_world(script, n_after, ckpt, logs, 0), "resumed"
+    )
+    assert all(s["resumed_from"] == banked for s in post)
+    assert all(s["epochs"] == 2 for s in post)
+    assert all(s["resized_to"] is None for s in post)
+
+    # Sample-exact across the topology change.
+    got = sorted(
+        _consumed_ids(str(logs), n_before) + _consumed_ids(str(logs),
+                                                           n_after)
+    )
+    assert got == ref_ids
+    np.testing.assert_allclose(
+        post[0]["loss"], ref_summaries[0]["loss"], rtol=5e-3
+    )
+
+    # The badput record: banked once (lead process of the resumed
+    # world), schema-valid, with all four phases attributed.
+    assert read_handoff(str(ckpt)) is None
+    with open(logs / "resize_bank.jsonl") as f:
+        records = [json.loads(line) for line in f if line.strip()]
+    assert len(records) == 1
+    rec = records[0]
+    assert tschema.validate_resize_record(rec) == []
+    assert rec["from_processes"] == n_before
+    assert rec["to_processes"] == n_after
+    assert rec["step"] == banked
+    assert rec["reason"] == "autoscaler"
+    assert rec["badput_seconds"] > 0
